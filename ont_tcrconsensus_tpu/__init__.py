@@ -1,0 +1,26 @@
+"""ont_tcrconsensus_tpu — a TPU-native framework for ONT TCR UMI consensus calling.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+schumacherlab/ONT-TCRconsensus (a CPU-cluster pipeline orchestrating
+minimap2/vsearch/edlib/spoa/medaka via Ray + subprocess; see
+/root/reference/ont_tcr_consensus/tcr_consensus.py:33-478 for the reference
+entry point). Instead of "Ray task -> subprocess -> files on disk", this
+framework streams padded, length-bucketed device batches through a library of
+JAX kernels:
+
+- ``ops``       device kernels: expected-error filtering, IUPAC fuzzy match,
+                batched edit distance, k-mer sketch + banded affine alignment,
+                pileup/consensus.
+- ``models``    Flax consensus-polisher RNN (medaka-class bi-GRU).
+- ``cluster``   greedy centroid UMI clustering and reference self-homology
+                region clustering driven by device distance batches.
+- ``parallel``  mesh management, sharded pipeline steps, wavefront sequence
+                parallelism, HBM batch budgeting.
+- ``io``        host data plane: FASTQ/FASTA streaming, encoding, batching,
+                a C++ fast parser, and a read simulator.
+- ``pipeline``  the end-to-end two-round UMI consensus pipeline, config and
+                stage-level resume.
+- ``qc``        QC artifacts, stats and analysis plots.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,12 @@
+"""Device kernels (jnp/Pallas) replacing the reference pipeline's native tools.
+
+Mapping to the reference (/root/reference):
+- ``ee_filter``     vsearch --fastq_filter          (preprocessing.py:129-148)
+- ``fuzzy_match``   edlib.align(mode="HW", IUPAC)   (extract_umis.py:89-96)
+- ``edit_distance`` vsearch pairwise identity       (vsearch_umi_cluster.py:21-54)
+- ``sketch``        minimap2 seeding                (minimap2_align.py:90-132)
+- ``align``         minimap2 base-level alignment   (minimap2_align.py:13-18, 90-138)
+- ``consensus``     spoa draft + pileup             (medaka smolecule --method spoa)
+"""
+
+from ont_tcrconsensus_tpu.ops import encode  # noqa: F401

@@ -1,0 +1,51 @@
+"""Expected-error read filtering on device.
+
+TPU-native replacement for ``vsearch --fastq_filter --fastq_maxee_rate R
+--fastq_minlen L`` (/root/reference/ont_tcr_consensus/preprocessing.py:129-148):
+a read passes iff
+
+    sum_i 10^(-Q_i/10) / len(read) <= max_ee_rate   and   len(read) >= min_len.
+
+The reference pins this to a single CPU per library; here it is one fused
+reduction over a padded ``(B, L)`` quality batch — bandwidth-bound, vmapped
+over the batch, shardable over a mesh data axis.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=())
+def expected_errors(quals: jax.Array, lengths: jax.Array) -> jax.Array:
+    """Per-read expected error count from a padded Phred batch.
+
+    Args:
+      quals: (B, L) uint8/int32 Phred scores (padding must be high-Q; it is
+        masked out regardless).
+      lengths: (B,) true read lengths.
+
+    Returns:
+      (B,) float32 expected errors.
+    """
+    q = quals.astype(jnp.float32)
+    pos = jnp.arange(q.shape[1], dtype=jnp.int32)[None, :]
+    in_read = pos < lengths[:, None]
+    perr = jnp.power(10.0, -q / 10.0)
+    return jnp.sum(jnp.where(in_read, perr, 0.0), axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def ee_rate_mask(
+    quals: jax.Array,
+    lengths: jax.Array,
+    max_ee_rate: jax.Array | float,
+    min_len: jax.Array | int,
+) -> jax.Array:
+    """Boolean keep-mask implementing the reference's quality+length filter."""
+    ee = expected_errors(quals, lengths)
+    lens = jnp.maximum(lengths, 1).astype(jnp.float32)
+    return (ee / lens <= max_ee_rate) & (lengths >= min_len)

@@ -1,0 +1,34 @@
+import numpy as np
+
+from ont_tcrconsensus_tpu.ops import ee_filter, encode
+
+
+def _np_expected_errors(qual_str):
+    q = np.frombuffer(qual_str.encode(), dtype=np.uint8).astype(np.float64) - 33
+    return float(np.sum(10.0 ** (-q / 10.0)))
+
+
+def test_expected_errors_matches_numpy():
+    quals = ["IIII", "!!!!", "5555555555", "I5I5I5"]
+    batch, lengths = encode.phred_batch(quals)
+    ee = np.asarray(ee_filter.expected_errors(batch, lengths))
+    for i, q in enumerate(quals):
+        np.testing.assert_allclose(ee[i], _np_expected_errors(q), rtol=1e-5)
+
+
+def test_padding_does_not_leak():
+    batch, lengths = encode.phred_batch(["!!", "!!!!"])
+    ee = np.asarray(ee_filter.expected_errors(batch, lengths))
+    # '!' is Q0 => perr 1.0 each
+    np.testing.assert_allclose(ee, [2.0, 4.0], rtol=1e-5)
+
+
+def test_ee_rate_mask_vsearch_semantics():
+    # max_ee_rate 0.07, min_len 4 (scaled-down reference config values,
+    # configs/run_config.json:6-7)
+    quals = ["IIII", "!!!!", "III"]  # Q40 passes, Q0 fails, too short fails
+    batch, lengths = encode.phred_batch(quals)
+    mask = np.asarray(
+        ee_filter.ee_rate_mask(batch, lengths, max_ee_rate=0.07, min_len=4)
+    )
+    assert mask.tolist() == [True, False, False]

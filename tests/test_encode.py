@@ -1,0 +1,53 @@
+import numpy as np
+
+from ont_tcrconsensus_tpu.ops import encode
+
+
+def test_encode_decode_roundtrip():
+    s = "ACGTACGTN"
+    codes = encode.encode_seq(s)
+    assert encode.decode_seq(codes) == s
+
+
+def test_encode_lowercase():
+    assert np.array_equal(encode.encode_seq("acgt"), encode.encode_seq("ACGT"))
+
+
+def test_revcomp_matches_reference_semantics():
+    # reference: str.maketrans("ACTG", "TGAC") then reverse
+    # (/root/reference/ont_tcr_consensus/extract_umis.py:10-12)
+    def ref_revcomp(seq):
+        return seq.translate(str.maketrans("ACTG", "TGAC"))[::-1]
+
+    for s in ["ACGT", "AAATTTCCCGGG", "TTTGGTTGGGGTTGGGGTTT"]:
+        assert encode.revcomp_str(s) == ref_revcomp(s)
+
+
+def test_iupac_masks_match_edlib_equality_table():
+    # The 60-pair table at extract_umis.py:26-87 reduces to: degenerate base
+    # matches exactly the ACGT expansions of its IUPAC definition.
+    expansions = {
+        "V": "ACG", "B": "CGT", "D": "AGT", "H": "ACT", "N": "ACGT",
+        "R": "AG", "Y": "CT", "S": "CG", "W": "AT", "K": "GT", "M": "AC",
+    }
+    for deg, bases in expansions.items():
+        dm = encode.encode_mask(deg)[0]
+        for b in "ACGT":
+            bm = encode.encode_mask(b)[0]
+            assert bool(dm & bm) == (b in bases), (deg, b)
+
+
+def test_pad_batch_shapes_and_lengths():
+    seqs = [encode.encode_seq(s) for s in ["ACGT", "AC", "ACGTACGT"]]
+    batch, lengths = encode.pad_batch(seqs, multiple=128)
+    assert batch.shape == (3, 128)
+    assert lengths.tolist() == [4, 2, 8]
+    assert (batch[1, 2:] == encode.PAD_CODE).all()
+
+
+def test_code_mask_consistency():
+    # codes -> masks must agree with direct mask encoding for ACGTN
+    s = "ACGTN"
+    via_codes = encode.CODE_TO_MASK[encode.encode_seq(s)]
+    direct = encode.encode_mask(s)
+    assert np.array_equal(via_codes, direct)
