@@ -13,13 +13,11 @@ over the batch, shardable over a mesh data axis.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 
 
-@functools.partial(jax.jit, static_argnames=())
+@jax.jit
 def expected_errors(quals: jax.Array, lengths: jax.Array) -> jax.Array:
     """Per-read expected error count from a padded Phred batch.
 
@@ -38,7 +36,7 @@ def expected_errors(quals: jax.Array, lengths: jax.Array) -> jax.Array:
     return jnp.sum(jnp.where(in_read, perr, 0.0), axis=1)
 
 
-@functools.partial(jax.jit, static_argnames=())
+@jax.jit
 def ee_rate_mask(
     quals: jax.Array,
     lengths: jax.Array,

@@ -92,15 +92,21 @@ def pad_batch(
     """Stack variable-length code arrays into a padded (B, L) batch + lengths.
 
     L is rounded up to ``multiple`` (TPU lane width) for layout friendliness.
+    Raises if a sequence exceeds the padded width — callers bucket by length
+    and must pick a sufficient ``pad_to``.
     """
     lengths = np.array([len(s) for s in seqs], dtype=np.int32)
     max_len = int(pad_to if pad_to is not None else (lengths.max() if len(seqs) else 0))
     if multiple > 1:
         max_len = ((max_len + multiple - 1) // multiple) * multiple
     max_len = max(max_len, multiple)
+    if len(seqs) and lengths.max() > max_len:
+        raise ValueError(
+            f"sequence of length {int(lengths.max())} exceeds padded width {max_len}"
+        )
     out = np.full((len(seqs), max_len), pad_value, dtype=np.uint8)
     for i, s in enumerate(seqs):
-        out[i, : len(s)] = s[:max_len]
+        out[i, : len(s)] = s
     return out, lengths
 
 
@@ -126,7 +132,10 @@ def phred_batch(quals: list[str], pad_to: int | None = None, multiple: int = 128
     Padding gets Q=93 (error prob ~5e-10) so padded tails contribute nothing
     to expected-error sums.
     """
-    arrs = [
-        np.frombuffer(q.encode("ascii"), dtype=np.uint8) - 33 for q in quals
-    ]
+    arrs = []
+    for q in quals:
+        raw = np.frombuffer(q.encode("ascii"), dtype=np.uint8)
+        if raw.size and raw.min() < 33:
+            raise ValueError("quality string contains characters below Phred-33 '!'")
+        arrs.append(raw - 33)
     return pad_batch(arrs, pad_to=pad_to, pad_value=93, multiple=multiple)

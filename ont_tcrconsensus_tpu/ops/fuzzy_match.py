@@ -1,0 +1,154 @@
+"""Infix (semi-global) fuzzy pattern matching with IUPAC degeneracy.
+
+TPU-native replacement for ``edlib.align(pattern, window, mode="HW", k=k,
+additionalEqualities=<60 IUPAC pairs>)`` used by the reference to locate
+degenerate UMI patterns inside fixed-size adapter windows
+(/root/reference/ont_tcr_consensus/extract_umis.py:19-107) and, in spirit, by
+``dorado trim`` for primer location (preprocessing.py:25-57).
+
+Semantics: find the substring of ``window`` minimizing the Levenshtein
+distance to ``pattern``, where a pattern/text base pair matches iff their
+4-bit IUPAC masks intersect (see :mod:`..ops.encode`). Deterministic
+tie-breaking (documented; the reference inherits edlib's undocumented one):
+
+- among optimal end positions, the smallest end is chosen;
+- among optimal start positions for that end, the smallest start is chosen.
+
+Algorithm: anti-dependency-free column DP. The text axis is a ``lax.scan``;
+inside a column the insertion cascade ``D[i][j] = min_l<=i (tmp[l] + i - l)``
+is a min-plus prefix scan computed as ``i + cummin(tmp - i)`` — no scalar
+loops, fully vectorized over (batch, pattern) on the VPU. A second scan on the
+reversed prefix recovers the match start exactly. Work per read window is
+O(L * m) with L ~ 128 and m ~ 32, vmapped over the batch and shardable over a
+mesh data axis.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+BIG = jnp.int32(1 << 20)
+
+
+def _column_step(col, text_char, pattern_mask):
+    """One DP column update for semi-global (free text start) alignment.
+
+    col: (m+1,) int32 previous column; text_char: scalar uint8 mask;
+    pattern_mask: (m,) uint8. Returns new column (m+1,).
+    """
+    sub = jnp.where((pattern_mask & text_char) != 0, 0, 1).astype(jnp.int32)
+    diag = col[:-1] + sub
+    up = col[1:] + 1
+    tmp = jnp.minimum(diag, up)
+    base = jnp.concatenate([jnp.zeros((1,), jnp.int32), tmp])
+    idx = jnp.arange(base.shape[0], dtype=jnp.int32)
+    cascaded = idx + jax.lax.associative_scan(jnp.minimum, base - idx)
+    return jnp.minimum(base, cascaded)
+
+
+def _final_row(pattern_mask: jax.Array, window: jax.Array) -> jax.Array:
+    """Distance of pattern vs best substring ending at each text position.
+
+    Returns (L+1,) int32: entry j = min edit distance over substrings of
+    window[:j] that end exactly at j (0 = empty prefix => distance m).
+    """
+    m = pattern_mask.shape[0]
+    init = jnp.arange(m + 1, dtype=jnp.int32)
+
+    def step(col, ch):
+        new = _column_step(col, ch, pattern_mask)
+        return new, new[m]
+
+    _, tail = jax.lax.scan(step, init, window)
+    return jnp.concatenate([jnp.array([m], jnp.int32), tail])
+
+
+def _find_one(pattern_mask, rev_pattern_mask, window, window_len):
+    """(dist, start, end_exclusive) for one window; dist=BIG if empty."""
+    L = window.shape[0]
+    row = _final_row(pattern_mask, window)  # (L+1,)
+    j = jnp.arange(L + 1, dtype=jnp.int32)
+    valid = j <= window_len
+    masked = jnp.where(valid, row, BIG)
+    dist = jnp.min(masked)
+    end = jnp.argmin(masked).astype(jnp.int32)  # first minimum => smallest end
+
+    # Recover the smallest start for this end: align the reversed pattern
+    # against the reversed window prefix [0, end); the largest reversed end
+    # position j2 with distance == dist gives start = end - j2.
+    r = jnp.arange(L, dtype=jnp.int32)
+    src = jnp.clip(end - 1 - r, 0, L - 1)
+    rev_prefix = jnp.where(r < end, window[src], jnp.uint8(0))
+    rrow = _final_row(rev_pattern_mask, rev_prefix)
+    rvalid = j <= end
+    hits = rvalid & (rrow == dist)
+    j2 = jnp.max(jnp.where(hits, j, -1))
+    start = end - j2
+    return dist, start, end
+
+
+@functools.partial(jax.jit, static_argnames=())
+def fuzzy_find(
+    pattern_mask: jax.Array,
+    windows: jax.Array,
+    window_lens: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Batched infix fuzzy match.
+
+    Args:
+      pattern_mask: (m,) uint8 IUPAC masks of the pattern.
+      windows: (B, L) uint8 IUPAC masks of text windows (0 = padding).
+      window_lens: (B,) int32 true window lengths.
+
+    Returns:
+      (dist, start, end): each (B,) int32. ``dist`` is the optimal edit
+      distance (compare against k on the caller side, mirroring edlib's
+      ``editDistance == -1`` contract); the match is ``window[start:end]``.
+    """
+    rev = pattern_mask[::-1]
+    return jax.vmap(lambda w, n: _find_one(pattern_mask, rev, w, n))(
+        windows, window_lens.astype(jnp.int32)
+    )
+
+
+def fuzzy_find_np(pattern: str, text: str):
+    """Pure-python reference with identical tie-breaking (for tests/debug)."""
+    import numpy as np
+
+    from ont_tcrconsensus_tpu.ops import encode
+
+    p = encode.encode_mask(pattern)
+    t = encode.encode_mask(text)
+    m, n = len(p), len(t)
+    D = np.zeros((m + 1, n + 1), dtype=np.int64)
+    D[:, 0] = np.arange(m + 1)
+    for jj in range(1, n + 1):
+        for ii in range(1, m + 1):
+            sub = 0 if (p[ii - 1] & t[jj - 1]) else 1
+            D[ii, jj] = min(D[ii - 1, jj - 1] + sub, D[ii - 1, jj] + 1, D[ii, jj - 1] + 1)
+        D[0, jj] = 0
+    dist = int(D[m].min())
+    end = int(D[m].argmin())
+    starts = [
+        s
+        for s in range(end + 1)
+        if _lev_np(p, t[s:end]) == dist
+    ]
+    return dist, min(starts), end
+
+
+def _lev_np(pmask, tmask):
+    import numpy as np
+
+    m, n = len(pmask), len(tmask)
+    D = np.zeros((m + 1, n + 1), dtype=np.int64)
+    D[:, 0] = np.arange(m + 1)
+    D[0, :] = np.arange(n + 1)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            sub = 0 if (pmask[i - 1] & tmask[j - 1]) else 1
+            D[i, j] = min(D[i - 1, j - 1] + sub, D[i - 1, j] + 1, D[i, j - 1] + 1)
+    return int(D[m, n])

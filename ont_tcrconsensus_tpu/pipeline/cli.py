@@ -1,0 +1,27 @@
+"""CLI entry point: ``tcr-consensus-tpu <run_config.json>``.
+
+Mirrors the reference console script ``tcr_consensus``
+(/root/reference/pyproject.toml:46-47, tcr_consensus.py:33-36).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Count unique TCR molecule nanopore consensus reads (TPU-native)."
+    )
+    parser.add_argument("json_config_file", help="Path to analysis run JSON config file")
+    args = parser.parse_args(argv)
+
+    from ont_tcrconsensus_tpu.pipeline.run import run_pipeline
+
+    run_pipeline(args.json_config_file)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

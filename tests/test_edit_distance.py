@@ -1,0 +1,91 @@
+import numpy as np
+
+from ont_tcrconsensus_tpu.ops import edit_distance, encode
+
+
+def _lev(a, b):
+    m, n = len(a), len(b)
+    D = np.zeros((m + 1, n + 1), dtype=np.int64)
+    D[:, 0] = np.arange(m + 1)
+    D[0, :] = np.arange(n + 1)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            D[i, j] = min(
+                D[i - 1, j - 1] + (a[i - 1] != b[j - 1]),
+                D[i - 1, j] + 1,
+                D[i, j - 1] + 1,
+            )
+    return int(D[m, n])
+
+
+def _rand_seqs(rng, n, lo, hi):
+    return [
+        "".join(rng.choice(list("ACGT")) for _ in range(rng.integers(lo, hi)))
+        for _ in range(n)
+    ]
+
+
+def test_pairwise_matches_numpy():
+    rng = np.random.default_rng(0)
+    a = _rand_seqs(rng, 16, 50, 70)
+    b = _rand_seqs(rng, 16, 50, 70)
+    ab, al = encode.encode_batch(a)
+    bb, bl = encode.encode_batch(b)
+    d = np.asarray(edit_distance.pairwise(ab, al, bb, bl))
+    for i in range(16):
+        assert d[i] == _lev(a[i], b[i]), i
+
+
+def test_many_vs_many_matches_numpy():
+    rng = np.random.default_rng(1)
+    q = _rand_seqs(rng, 6, 56, 68)
+    t = _rand_seqs(rng, 5, 56, 68)
+    qb, ql = encode.encode_batch(q)
+    tb, tl = encode.encode_batch(t)
+    D = np.asarray(edit_distance.many_vs_many(qb, ql, tb, tl))
+    for i in range(6):
+        for j in range(5):
+            assert D[i, j] == _lev(q[i], t[j]), (i, j)
+
+
+def test_identity_of_mutated_umis():
+    # a UMI with 2 substitutions over 60nt: identity = 1 - 2/60 ~ 0.967
+    rng = np.random.default_rng(2)
+    u = "".join(rng.choice(list("ACGT")) for _ in range(60))
+    v = u[:10] + ("A" if u[10] != "A" else "C") + u[11:30] + (
+        "G" if u[30] != "G" else "T"
+    ) + u[31:]
+    ub, ul = encode.encode_batch([u])
+    vb, vl = encode.encode_batch([v])
+    ident = np.asarray(edit_distance.identity_matrix(ub, ul, vb, vl))[0, 0]
+    np.testing.assert_allclose(ident, 1 - 2 / 60, rtol=1e-6)
+    # pipeline thresholds: joins at 0.93, separate at 0.97
+    assert ident > 0.93 and ident < 0.97
+
+
+def test_kmer_prefilter_ranks_true_match_first():
+    rng = np.random.default_rng(3)
+    targets = _rand_seqs(rng, 32, 56, 68)
+    # queries are lightly mutated copies of targets
+    q_idx = [3, 17, 30]
+    queries = []
+    for i in q_idx:
+        t = list(targets[i])
+        for pos in rng.integers(0, len(t), 2):
+            t[pos] = rng.choice(list("ACGT"))
+        queries.append("".join(t))
+    qb, ql = encode.encode_batch(queries)
+    tb, tl = encode.encode_batch(targets)
+    qp = edit_distance.kmer_profile(qb, ql)
+    tp = edit_distance.kmer_profile(tb, tl)
+    cand = np.asarray(edit_distance.top_candidates(qp, tp, top_k=4))
+    for row, i in enumerate(q_idx):
+        assert i in cand[row], (row, i, cand[row])
+
+
+def test_empty_vs_nonempty():
+    ab, al = encode.encode_batch(["ACGT"])
+    bb, bl = encode.encode_batch(["ACGT"])
+    bl0 = np.array([0], dtype=np.int32)
+    d = np.asarray(edit_distance.pairwise(ab, al, bb, bl0))
+    assert d[0] == 4

@@ -1,0 +1,96 @@
+import numpy as np
+import pytest
+
+from ont_tcrconsensus_tpu.ops import encode, fuzzy_match
+
+UMI_FWD = "TTTVVTTVVVVTTVVVVTTVVVVTTVVVVTTT"  # configs/run_config.json:11
+UMI_REV = "AAABBBBAABBBBAABBBBAABBBBAABBAAA"  # configs/run_config.json:12
+
+
+def _umi_instance(rng, pattern):
+    # random concrete realization of a degenerate pattern
+    choices = {"V": "ACG", "B": "CGT", "T": "T", "A": "A"}
+    return "".join(rng.choice(list(choices[c])) for c in pattern)
+
+
+def _run_batch(pattern, texts):
+    pm = encode.encode_mask(pattern)
+    wm, lens = encode.encode_mask_batch(texts)
+    d, s, e = fuzzy_match.fuzzy_find(pm, wm, lens)
+    return np.asarray(d), np.asarray(s), np.asarray(e)
+
+
+def test_exact_embedded_match():
+    rng = np.random.default_rng(0)
+    umi = _umi_instance(rng, UMI_FWD)
+    text = "ACGTACGTAC" + umi + "GGTTGAC"
+    d, s, e = _run_batch(UMI_FWD, [text])
+    assert d[0] == 0
+    assert text[s[0] : e[0]] == umi
+
+
+def test_matches_python_reference_random():
+    rng = np.random.default_rng(1)
+    texts = []
+    for _ in range(24):
+        n = int(rng.integers(30, 81))
+        texts.append("".join(rng.choice(list("ACGT")) for _ in range(n)))
+    d, s, e = _run_batch(UMI_FWD, texts)
+    for i, t in enumerate(texts):
+        rd, rs, re_ = fuzzy_match.fuzzy_find_np(UMI_FWD, t)
+        assert d[i] == rd, (i, t)
+        assert e[i] == re_, (i, t)
+        assert s[i] == rs, (i, t)
+
+
+def test_single_errors_give_distance_one():
+    rng = np.random.default_rng(2)
+    umi = _umi_instance(rng, UMI_REV)
+    # substitution of a fixed 'A' flank position to 'G' (A-flank only matches A)
+    mutated = "C" + umi[:2] + "G" + umi[3:] + "TT"
+    d, _, _ = _run_batch(UMI_REV, [mutated])
+    assert d[0] == 1
+    # deletion
+    deleted = "GG" + umi[:10] + umi[11:] + "AACC"
+    d, _, _ = _run_batch(UMI_REV, [deleted])
+    assert d[0] == 1
+    # insertion
+    inserted = umi[:16] + "T" + umi[16:]
+    d, _, _ = _run_batch(UMI_REV, [inserted])
+    assert d[0] == 1
+
+
+def test_k_threshold_contract():
+    # caller-side k: reference treats dist > k as no-match
+    # (extract_umis.py:89-98 returns None on editDistance == -1)
+    d, _, _ = _run_batch("TTTT", ["GGGGGGGG"])
+    assert d[0] > 3  # no decent match
+
+
+def test_padding_is_inert():
+    rng = np.random.default_rng(3)
+    umi = _umi_instance(rng, UMI_FWD)
+    short = "AC" + umi  # well under pad width
+    d1, s1, e1 = _run_batch(UMI_FWD, [short])
+    d2, s2, e2 = _run_batch(UMI_FWD, [short + ""])  # identical
+    assert (d1, s1, e1) == (d2, s2, e2)
+    assert d1[0] == 0 and short[s1[0] : e1[0]] == umi
+
+
+@pytest.mark.parametrize("pattern", [UMI_FWD, UMI_REV])
+def test_realistic_adapter_windows(pattern):
+    # reference slices 81nt 5' / 76nt 3' windows (extract_umis.py:110-126)
+    rng = np.random.default_rng(4)
+    wins, truths = [], []
+    for _ in range(16):
+        umi = _umi_instance(rng, pattern)
+        pre = "".join(rng.choice(list("ACGT")) for _ in range(rng.integers(5, 30)))
+        post = "".join(rng.choice(list("ACGT")) for _ in range(10))
+        win = (pre + umi + post)[:81]
+        wins.append(win)
+        truths.append((win, umi, len(pre)))
+    d, s, e = _run_batch(pattern, wins)
+    for i, (win, umi, pre_len) in enumerate(truths):
+        assert d[i] == 0
+        assert s[i] == pre_len
+        assert win[s[i] : e[i]] == umi
