@@ -1,0 +1,175 @@
+"""Host-side FASTA/FASTQ streaming.
+
+The reference leans on pysam.FastxFile + external tools for all sequence IO
+(e.g. /root/reference/ont_tcr_consensus/extract_umis.py:216,
+region_split.py:241). Here IO is a first-party streaming layer that feeds the
+device batcher: gzip-transparent record iteration, zero intermediate files,
+and batched emission sized for padded device arrays. A C fast path
+(:mod:`.native`) accelerates parsing when the compiled extension is present;
+this module is the always-available pure-Python fallback with identical
+semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import os
+from collections.abc import Iterable, Iterator
+from typing import IO
+
+
+@dataclasses.dataclass
+class FastxRecord:
+    name: str        # first whitespace-delimited token of the header
+    comment: str     # remainder of the header ('' if none)
+    sequence: str
+    quality: str | None = None  # None for FASTA
+
+    @property
+    def header(self) -> str:
+        return f"{self.name} {self.comment}".rstrip()
+
+
+def _open_text(path: str | os.PathLike[str]) -> IO[str]:
+    p = os.fspath(path)
+    if p.endswith(".gz"):
+        return gzip.open(p, "rt")
+    return open(p)
+
+
+def _split_header(line: str) -> tuple[str, str]:
+    parts = line[1:].rstrip("\n").split(None, 1)
+    if not parts:
+        return "", ""
+    return parts[0], parts[1] if len(parts) > 1 else ""
+
+
+def read_fastx(path: str | os.PathLike[str]) -> Iterator[FastxRecord]:
+    """Iterate records from a FASTA/FASTQ file (.gz transparent).
+
+    Format is sniffed from the first record character. FASTA sequences may be
+    multi-line; FASTQ records must be 4-line (the only form ONT emits).
+    """
+    with _open_text(path) as fh:
+        first = fh.read(1)
+        if not first:
+            return
+        if first == ">":
+            name, comment = _split_header(">" + fh.readline())
+            seq_parts: list[str] = []
+            for line in fh:
+                if line.startswith(">"):
+                    yield FastxRecord(name, comment, "".join(seq_parts))
+                    name, comment = _split_header(line)
+                    seq_parts = []
+                else:
+                    seq_parts.append(line.strip())
+            yield FastxRecord(name, comment, "".join(seq_parts))
+        elif first == "@":
+            header = "@" + fh.readline()
+            while header.strip():
+                name, comment = _split_header(header)
+                seq = fh.readline().strip()
+                plus = fh.readline()
+                qual = fh.readline().strip()
+                if not plus.startswith("+"):
+                    raise ValueError(f"malformed FASTQ record near {name!r} in {path}")
+                yield FastxRecord(name, comment, seq, qual)
+                header = fh.readline()
+        else:
+            raise ValueError(f"{path}: not FASTA/FASTQ (starts with {first!r})")
+
+
+def read_fasta_dict(path: str | os.PathLike[str]) -> dict[str, str]:
+    """FASTA -> {name: sequence} (reference region_split.py:29-58 analogue)."""
+    out: dict[str, str] = {}
+    for rec in read_fastx(path):
+        if rec.name in out:
+            raise ValueError(f"duplicate sequence name {rec.name!r} in {path}")
+        out[rec.name] = rec.sequence
+    return out
+
+
+def write_fasta(
+    path: str | os.PathLike[str],
+    records: Iterable[tuple[str, str]],
+    append: bool = False,
+    width: int = 0,
+) -> int:
+    """Write (header, seq) pairs; returns the number written.
+
+    ``width=0`` writes single-line sequences (what every downstream stage of
+    the pipeline expects).
+    """
+    n = 0
+    mode = "a" if append else "w"
+    p = os.fspath(path)
+    opener = gzip.open(p, mode + "t") if p.endswith(".gz") else open(p, mode)
+    with opener as fh:
+        for header, seq in records:
+            fh.write(f">{header}\n")
+            if width and len(seq) > width:
+                for i in range(0, len(seq), width):
+                    fh.write(seq[i : i + width] + "\n")
+            else:
+                fh.write(seq + "\n")
+            n += 1
+    return n
+
+
+def write_fastq(
+    path: str | os.PathLike[str],
+    records: Iterable[tuple[str, str, str]],
+    append: bool = False,
+) -> int:
+    """Write (header, seq, qual) triples; returns the number written."""
+    n = 0
+    mode = "a" if append else "w"
+    p = os.fspath(path)
+    opener = gzip.open(p, mode + "t") if p.endswith(".gz") else open(p, mode)
+    with opener as fh:
+        for header, seq, qual in records:
+            fh.write(f"@{header}\n{seq}\n+\n{qual}\n")
+            n += 1
+    return n
+
+
+def count_fasta_records(path: str | os.PathLike[str]) -> int:
+    """Header count — the reference shells out to ``grep -c '^>'``
+    (/root/reference/ont_tcr_consensus/count.py:9-20)."""
+    n = 0
+    with _open_text(path) as fh:
+        for line in fh:
+            if line.startswith(">"):
+                n += 1
+    return n
+
+
+def fastq_stats(path: str | os.PathLike[str]) -> dict[str, float]:
+    """Summary stats equivalent to the reference's ``seqkit stat -a`` QC dumps
+    (/root/reference/ont_tcr_consensus/preprocessing.py:82-99): record count,
+    total bases, min/mean/max length, mean quality (if FASTQ)."""
+    n = 0
+    total = 0
+    mn = None
+    mx = 0
+    qsum = 0.0
+    qn = 0
+    for rec in read_fastx(path):
+        ln = len(rec.sequence)
+        n += 1
+        total += ln
+        mn = ln if mn is None else min(mn, ln)
+        mx = max(mx, ln)
+        if rec.quality:
+            qsum += sum(rec.quality.encode("ascii")) - 33 * len(rec.quality)
+            qn += len(rec.quality)
+    return {
+        "num_seqs": n,
+        "sum_len": total,
+        "min_len": mn or 0,
+        "avg_len": (total / n) if n else 0.0,
+        "max_len": mx,
+        "avg_qual": (qsum / qn) if qn else 0.0,
+    }

@@ -1,0 +1,120 @@
+"""Per-library analysis directory layout.
+
+Mirrors the reference's 11-dir tree (/root/reference/ont_tcr_consensus/
+utils.py:5-43) so downstream tooling (the analysis notebook, users' scripts)
+finds artifacts in the same places, but adds a stage-resume manifest: the
+reference refuses to run if the output dir exists (tcr_consensus.py:84-86);
+here an existing dir is resumable when ``resume=True``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+
+SUBDIRS = (
+    "logs",
+    "align",
+    "region_cluster_fasta",
+    "umi_fasta",
+    "clustering",
+    "fasta",
+    "clustering_consensus",
+    "region_fasta",
+    "consensus_umi_fasta",
+    "counts",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LibraryLayout:
+    library: str
+    library_dir: str
+
+    @property
+    def logs(self) -> str:
+        return os.path.join(self.library_dir, "logs")
+
+    @property
+    def align(self) -> str:
+        return os.path.join(self.library_dir, "align")
+
+    @property
+    def region_cluster_fasta(self) -> str:
+        return os.path.join(self.library_dir, "region_cluster_fasta")
+
+    @property
+    def umi_fasta(self) -> str:
+        return os.path.join(self.library_dir, "umi_fasta")
+
+    @property
+    def clustering(self) -> str:
+        return os.path.join(self.library_dir, "clustering")
+
+    @property
+    def fasta(self) -> str:
+        return os.path.join(self.library_dir, "fasta")
+
+    @property
+    def clustering_consensus(self) -> str:
+        return os.path.join(self.library_dir, "clustering_consensus")
+
+    @property
+    def region_fasta(self) -> str:
+        return os.path.join(self.library_dir, "region_fasta")
+
+    @property
+    def consensus_umi_fasta(self) -> str:
+        return os.path.join(self.library_dir, "consensus_umi_fasta")
+
+    @property
+    def counts(self) -> str:
+        return os.path.join(self.library_dir, "counts")
+
+    @property
+    def manifest_path(self) -> str:
+        return os.path.join(self.library_dir, "stage_manifest.json")
+
+    # --- stage-level resume -------------------------------------------------
+
+    def completed_stages(self) -> dict[str, float]:
+        if not os.path.exists(self.manifest_path):
+            return {}
+        with open(self.manifest_path) as fh:
+            return json.load(fh)
+
+    def mark_stage_done(self, stage: str) -> None:
+        done = self.completed_stages()
+        done[stage] = time.time()
+        tmp = self.manifest_path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(done, fh, indent=1)
+        os.replace(tmp, self.manifest_path)
+
+    def stage_done(self, stage: str) -> bool:
+        return stage in self.completed_stages()
+
+
+def library_name_from_fastq(fastq: str | os.PathLike[str]) -> str:
+    """'/path/barcode01.fastq.gz' -> 'barcode01' (utils.py:6)."""
+    return os.path.basename(os.fspath(fastq)).split(".")[0]
+
+
+def init_library_dir(
+    fastq: str | os.PathLike[str],
+    nano_dir: str | os.PathLike[str],
+    resume: bool = False,
+) -> LibraryLayout:
+    """Create (or, with resume, reuse) the per-library tree."""
+    library = library_name_from_fastq(fastq)
+    library_dir = os.path.join(os.fspath(nano_dir), library)
+    if os.path.exists(library_dir) and not resume:
+        raise FileExistsError(
+            f"{library_dir} exists; pass resume=True to continue a previous run"
+        )
+    os.makedirs(library_dir, exist_ok=True)
+    for sub in SUBDIRS:
+        os.makedirs(os.path.join(library_dir, sub), exist_ok=True)
+    return LibraryLayout(library=library, library_dir=library_dir)

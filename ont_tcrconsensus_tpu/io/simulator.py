@@ -1,0 +1,205 @@
+"""Synthetic ONT TCR-amplicon read simulator.
+
+The reference repo has no tests and no simulator (SURVEY §4); its behavioral
+spec is empirical QC on real PromethION runs. This module is the rebuild's
+test bed (SURVEY §7 M0): generate a toy reference library plus reads with
+*known* per-molecule UMIs and a controllable error model, so every stage —
+EE filtering, alignment, region split, UMI extraction, clustering, consensus,
+counting — can be asserted against ground truth, up to bit-exact UMI counts.
+
+Amplicon structure mirrors what the reference pipeline assumes
+(/root/reference/ont_tcr_consensus/extract_umis.py:110-126: fwd UMI within
+the first ~81 nt of the oriented read, rev UMI within the last ~76 nt;
+configs/run_config.json:9-12):
+
+    5'- left_flank . UMI_fwd . region_sequence . UMI_rev . right_flank -3'
+
+Reads are emitted in + or - orientation with ONT-like errors
+(sub/ins/del, qualities consistent with the error rate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+_BASES = np.array(list("ACGT"))
+_IUPAC_CHOICES = {
+    "A": "A", "C": "C", "G": "G", "T": "T",
+    "R": "AG", "Y": "CT", "S": "CG", "W": "AT", "K": "GT", "M": "AC",
+    "B": "CGT", "D": "AGT", "H": "ACT", "V": "ACG", "N": "ACGT",
+}
+
+# Short fixed flanks standing in for the sequencing adapters/primers that
+# dorado trim leaves behind; lengths chosen so UMIs sit inside the default
+# 81/76 nt softclip windows (run_config.json:9-10).
+LEFT_FLANK = "CAAGCAGAAGACGGCATACGAGAT"
+RIGHT_FLANK = "AATGATACGGCGACCACCGAGATC"
+
+
+def _rand_seq(rng: np.random.Generator, n: int) -> str:
+    return "".join(_BASES[rng.integers(0, 4, size=n)])
+
+
+def instantiate_iupac(rng: np.random.Generator, pattern: str) -> str:
+    """Draw a concrete sequence from a degenerate IUPAC pattern."""
+    return "".join(
+        c if len(_IUPAC_CHOICES[c]) == 1 else _IUPAC_CHOICES[c][rng.integers(len(_IUPAC_CHOICES[c]))]
+        for c in pattern.upper()
+    )
+
+
+def revcomp(seq: str) -> str:
+    """Delegates to the pipeline's own encoding so semantics never diverge."""
+    from ont_tcrconsensus_tpu.ops import encode
+
+    return encode.revcomp_str(seq)
+
+
+def mutate(
+    rng: np.random.Generator,
+    seq: str,
+    sub_rate: float,
+    ins_rate: float,
+    del_rate: float,
+) -> tuple[str, str]:
+    """Apply iid sub/ins/del errors; return (read, phred33 quality string).
+
+    Quality is drawn around the Q implied by the total error rate, so the
+    expected-error filter sees realistic values.
+    """
+    total = max(sub_rate + ins_rate + del_rate, 1e-6)
+    q_mid = int(np.clip(-10.0 * np.log10(total), 5, 40))
+    out: list[str] = []
+    quals: list[int] = []
+    for ch in seq:
+        r = rng.random()
+        if r < del_rate:
+            continue
+        if r < del_rate + ins_rate:
+            out.append(str(_BASES[rng.integers(4)]))
+            quals.append(max(2, q_mid - 6))
+        if rng.random() < sub_rate:
+            choices = [b for b in "ACGT" if b != ch]
+            out.append(choices[rng.integers(3)])
+            quals.append(max(2, q_mid - 4))
+        else:
+            out.append(ch)
+            quals.append(int(np.clip(rng.normal(q_mid, 3), 2, 50)))
+    qual = "".join(chr(33 + q) for q in quals)
+    return "".join(out), qual
+
+
+@dataclasses.dataclass
+class Molecule:
+    """Ground truth for one unique molecule (one expected consensus)."""
+
+    region: str
+    umi_fwd: str   # concrete fwd UMI (as in + orientation)
+    umi_rev: str   # concrete rev UMI (as in + orientation)
+    num_reads: int
+
+    @property
+    def combined_umi(self) -> str:
+        return self.umi_fwd + self.umi_rev
+
+
+@dataclasses.dataclass
+class SimulatedLibrary:
+    reference: dict[str, str]        # region name -> sequence
+    molecules: list[Molecule]
+    reads: list[tuple[str, str, str]]  # (header, sequence, qual)
+
+    @property
+    def true_counts(self) -> dict[str, int]:
+        counts: dict[str, int] = {}
+        for m in self.molecules:
+            counts[m.region] = counts.get(m.region, 0) + 1
+        return counts
+
+
+def make_reference(
+    rng: np.random.Generator,
+    num_regions: int = 8,
+    region_len: tuple[int, int] = (1500, 2200),
+    num_similar_pairs: int = 0,
+    similar_divergence: float = 0.01,
+    num_negative_controls: int = 0,
+) -> dict[str, str]:
+    """Toy TCR reference library.
+
+    ``num_similar_pairs`` appends near-duplicate regions (>= 99% identical by
+    default) to exercise the self-homology region clustering
+    (region_split.py:61-216). Negative controls get the reference's reserved
+    suffixes (region_split.py:302-309) and receive no molecules.
+    """
+    ref: dict[str, str] = {}
+    for i in range(num_regions):
+        n = int(rng.integers(region_len[0], region_len[1] + 1))
+        ref[f"TCR{i:04d}"] = _rand_seq(rng, n)
+    names = list(ref)
+    for j in range(num_similar_pairs):
+        src = names[j % len(names)]
+        seq = list(ref[src])
+        n_mut = max(1, int(len(seq) * similar_divergence))
+        for pos in rng.choice(len(seq), size=n_mut, replace=False):
+            choices = [b for b in "ACGT" if b != seq[pos]]
+            seq[pos] = choices[rng.integers(3)]
+        ref[f"{src}_sim{j}"] = "".join(seq)
+    for k in range(num_negative_controls):
+        n = int(rng.integers(region_len[0], region_len[1] + 1))
+        ref[f"NC{k:03d}_full_n"] = _rand_seq(rng, n)
+    return ref
+
+
+def simulate_library(
+    seed: int = 0,
+    num_regions: int = 8,
+    molecules_per_region: tuple[int, int] = (2, 6),
+    reads_per_molecule: tuple[int, int] = (4, 12),
+    sub_rate: float = 0.01,
+    ins_rate: float = 0.005,
+    del_rate: float = 0.005,
+    umi_fwd_pattern: str = "TTTVVTTVVVVTTVVVVTTVVVVTTVVVVTTT",
+    umi_rev_pattern: str = "AAABBBBAABBBBAABBBBAABBBBAABBAAA",
+    reference: dict[str, str] | None = None,
+    **reference_kwargs,
+) -> SimulatedLibrary:
+    """Generate a full library with ground truth.
+
+    Reads are shuffled and emitted in random +/- orientation; headers carry
+    ``mol=<i>`` ground-truth tags (ignored by the pipeline, used by tests).
+    """
+    rng = np.random.default_rng(seed)
+    ref = reference if reference is not None else make_reference(
+        rng, num_regions=num_regions, **reference_kwargs
+    )
+    molecules: list[Molecule] = []
+    reads: list[tuple[str, str, str]] = []
+    countable = [n for n in ref if not n.endswith(("_v_n", "cdr3j_n", "full_n"))]
+    for region in countable:
+        n_mol = int(rng.integers(molecules_per_region[0], molecules_per_region[1] + 1))
+        for _ in range(n_mol):
+            mol = Molecule(
+                region=region,
+                umi_fwd=instantiate_iupac(rng, umi_fwd_pattern),
+                umi_rev=instantiate_iupac(rng, umi_rev_pattern),
+                num_reads=int(rng.integers(reads_per_molecule[0], reads_per_molecule[1] + 1)),
+            )
+            molecules.append(mol)
+    for mi, mol in enumerate(molecules):
+        template = (
+            LEFT_FLANK + mol.umi_fwd + ref[mol.region] + mol.umi_rev + RIGHT_FLANK
+        )
+        for ri in range(mol.num_reads):
+            seq, qual = mutate(rng, template, sub_rate, ins_rate, del_rate)
+            if rng.random() < 0.5:
+                seq, qual = revcomp(seq), qual[::-1]
+                orient = "-"
+            else:
+                orient = "+"
+            reads.append((f"read_m{mi}_r{ri} mol={mi} orient={orient}", seq, qual))
+    order = rng.permutation(len(reads))
+    reads = [reads[i] for i in order]
+    return SimulatedLibrary(reference=ref, molecules=molecules, reads=reads)

@@ -23,8 +23,6 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from ont_tcrconsensus_tpu.ops.fuzzy_match import BIG
-
 
 def _nw_pair(a: jax.Array, a_len: jax.Array, b: jax.Array, b_len: jax.Array) -> jax.Array:
     """Unit-cost global edit distance between two padded code sequences.
@@ -56,13 +54,13 @@ def _nw_pair(a: jax.Array, a_len: jax.Array, b: jax.Array, b_len: jax.Array) -> 
     return col[a_len]
 
 
-@functools.partial(jax.jit)
+@jax.jit
 def pairwise(a, a_lens, b, b_lens):
     """(B, La) x (B, Lb) -> (B,) elementwise edit distances."""
     return jax.vmap(_nw_pair)(a, a_lens.astype(jnp.int32), b, b_lens.astype(jnp.int32))
 
 
-@functools.partial(jax.jit)
+@jax.jit
 def many_vs_many(queries, q_lens, targets, t_lens):
     """(Q, L) x (T, L) -> (Q, T) edit-distance matrix."""
     q_lens = q_lens.astype(jnp.int32)
@@ -74,14 +72,14 @@ def many_vs_many(queries, q_lens, targets, t_lens):
     return jax.vmap(one_q)(queries, q_lens)
 
 
-@functools.partial(jax.jit)
+@jax.jit
 def identity_matrix(queries, q_lens, targets, t_lens):
-    """(Q, T) identity = 1 - d / max(len_q, len_t); 0 for empty pairs."""
+    """(Q, T) identity = 1 - d / max(len_q, len_t); 0 if either side is empty."""
     d = many_vs_many(queries, q_lens, targets, t_lens).astype(jnp.float32)
-    denom = jnp.maximum(
-        jnp.maximum(q_lens[:, None], t_lens[None, :]).astype(jnp.float32), 1.0
-    )
-    return 1.0 - d / denom
+    longest = jnp.maximum(q_lens[:, None], t_lens[None, :]).astype(jnp.float32)
+    either_empty = (q_lens[:, None] == 0) | (t_lens[None, :] == 0)
+    ident = 1.0 - d / jnp.maximum(longest, 1.0)
+    return jnp.where(either_empty, 0.0, ident)
 
 
 def kmer_profile(codes: jax.Array, lengths: jax.Array, k: int = 4) -> jax.Array:

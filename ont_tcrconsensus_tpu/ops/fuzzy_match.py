@@ -25,8 +25,6 @@ mesh data axis.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 
@@ -67,7 +65,11 @@ def _final_row(pattern_mask: jax.Array, window: jax.Array) -> jax.Array:
 
 
 def _find_one(pattern_mask, rev_pattern_mask, window, window_len):
-    """(dist, start, end_exclusive) for one window; dist=BIG if empty."""
+    """(dist, start, end_exclusive) for one window.
+
+    An empty window yields dist=m (the whole pattern deleted) — always above
+    any sane k threshold, so callers' ``dist <= k`` gate rejects it.
+    """
     L = window.shape[0]
     row = _final_row(pattern_mask, window)  # (L+1,)
     j = jnp.arange(L + 1, dtype=jnp.int32)
@@ -90,7 +92,7 @@ def _find_one(pattern_mask, rev_pattern_mask, window, window_len):
     return dist, start, end
 
 
-@functools.partial(jax.jit, static_argnames=())
+@jax.jit
 def fuzzy_find(
     pattern_mask: jax.Array,
     windows: jax.Array,

@@ -1,0 +1,164 @@
+"""Validated run configuration.
+
+The reference reads one flat JSON eagerly into locals with no defaults and no
+validation (/root/reference/ont_tcr_consensus/tcr_consensus.py:38-71;
+configs/run_config.json:1-32 — every key required, KeyError if absent). Here
+the same knobs live on a typed dataclass with defaults, type/range checks and
+a clear error message per key, plus TPU-specific keys (device batch sizes,
+mesh shape). Unknown keys are rejected so typos fail fast.
+
+Derived values mirror the reference exactly:
+- ``cluster_identity = 1 - max_ee_rate_base`` (tcr_consensus.py:68)
+- ``blast_id_threshold`` / ``minimal_region_overlap_consensus`` default to the
+  measured max reference self-homology (tcr_consensus.py:99-102), resolved at
+  pipeline time, not config-load time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+# Keys accepted for compatibility with the reference config but unused here
+# (they configure external binaries this framework replaces).
+_COMPAT_IGNORED = {
+    "dorado_excutable",  # sic — reference's own spelling (run_config.json:30)
+    "dorado_executable",
+    "nanopore_tcr_seq_primers_fasta",
+    "medaka_model",
+    "medaka_memory_gb_per_umi_cluster",
+    "medaka_memory_gb_task_overhead",
+    "max_cap_medaka_memory_gb",
+}
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """All pipeline knobs. Field names match the reference JSON keys."""
+
+    # --- inputs ---
+    reference_file: str
+    fastq_pass_dir: str
+
+    # --- flow control ---
+    only_run_reference_self_homology: bool = False
+    delete_tmp_files: bool = True
+
+    # --- read preprocessing (EE filter; reference preprocessing.py:104-159) ---
+    dorado_trim_subsample_fastq: int | None = None
+    minimal_length: int = 1470
+    max_ee_rate_base: float = 0.07
+
+    # --- alignment / region split (minimap2_align.py, region_split.py) ---
+    minimal_region_overlap: float = 0.95
+    max_softclip_5_end: int = 81
+    max_softclip_3_end: int = 76
+
+    # --- UMI extraction (extract_umis.py:19-107) ---
+    umi_fwd: str = "TTTVVTTVVVVTTVVVVTTVVVVTTVVVVTTT"
+    umi_rev: str = "AAABBBBAABBBBAABBBBAABBBBAABBAAA"
+    max_pattern_dist: int = 3
+    min_umi_length: int = 58
+    max_umi_length: int = 68
+
+    # --- UMI clustering round 1 (vsearch_umi_cluster.py:21-54) ---
+    vsearch_identity: float = 0.93
+    min_reads_per_cluster: int = 4
+    max_reads_per_cluster: int = 60
+    balance_strands: bool = False
+
+    # --- UMI cross-region audit (extract_umis.py:345-369) ---
+    compare_umi_overlap_between_regions: bool = False
+    overlapping_umi_edit_threshold: int = 1
+
+    # --- consensus round 2 (tcr_consensus.py:356-444) ---
+    minimal_region_overlap_consensus: float | None = None
+    blast_id_threshold: float | None = None
+    vsearch_identity_consensus: float = 0.97
+
+    # --- polishing ---
+    # "poa" = draft consensus only; "rnn" = draft + Flax polisher pass.
+    polish_method: str = "rnn"
+
+    # --- TPU execution (new; no reference analogue) ---
+    backend: str = "jax"              # "jax" | "numpy" (debug)
+    read_batch_size: int = 2048       # reads per device batch
+    umi_batch_size: int = 4096        # UMIs per distance-matrix tile
+    max_read_length: int = 4096       # padded read width cap
+    mesh_shape: dict[str, int] | None = None  # e.g. {"data": 8}
+    resume: bool = False              # stage-level resume from manifest
+
+    @property
+    def cluster_identity(self) -> float:
+        """Region-cluster threshold; reference tcr_consensus.py:68."""
+        return 1.0 - self.max_ee_rate_base
+
+    def validate(self) -> None:
+        if not self.reference_file:
+            raise ValueError("reference_file is required")
+        if not self.fastq_pass_dir:
+            raise ValueError("fastq_pass_dir is required")
+        for name, lo, hi in (
+            ("max_ee_rate_base", 0.0, 1.0),
+            ("minimal_region_overlap", 0.0, 1.0),
+            ("vsearch_identity", 0.0, 1.0),
+            ("vsearch_identity_consensus", 0.0, 1.0),
+            ("blast_id_threshold", 0.0, 1.0),                # nullable
+            ("minimal_region_overlap_consensus", 0.0, 1.0),  # nullable
+        ):
+            v = getattr(self, name)
+            if v is not None and not (lo <= v <= hi):
+                raise ValueError(f"{name}={v} outside [{lo}, {hi}]")
+        for name in ("dorado_trim_subsample_fastq",):  # nullable int
+            v = getattr(self, name)
+            if v is not None and (not isinstance(v, int) or v <= 0):
+                raise ValueError(f"{name}={v!r} must be a positive int or null")
+        if not isinstance(self.overlapping_umi_edit_threshold, int) or (
+            self.overlapping_umi_edit_threshold < 0
+        ):
+            raise ValueError("overlapping_umi_edit_threshold must be a non-negative int")
+        for name in (
+            "minimal_length", "max_pattern_dist", "min_umi_length",
+            "max_umi_length", "min_reads_per_cluster", "max_reads_per_cluster",
+            "read_batch_size", "umi_batch_size", "max_read_length",
+            "max_softclip_5_end", "max_softclip_3_end",
+        ):
+            v = getattr(self, name)
+            if not isinstance(v, int) or v < 0:
+                raise ValueError(f"{name}={v!r} must be a non-negative int")
+        if self.min_umi_length > self.max_umi_length:
+            raise ValueError("min_umi_length > max_umi_length")
+        if self.min_reads_per_cluster > self.max_reads_per_cluster:
+            raise ValueError("min_reads_per_cluster > max_reads_per_cluster")
+        if self.polish_method not in ("poa", "rnn"):
+            raise ValueError(f"polish_method={self.polish_method!r} not in ('poa', 'rnn')")
+        if self.backend not in ("jax", "numpy"):
+            raise ValueError(f"backend={self.backend!r} not in ('jax', 'numpy')")
+        for pat_name in ("umi_fwd", "umi_rev"):
+            pat = getattr(self, pat_name)
+            if not pat or any(c not in "ACGTUNRYSWKMBDHV" for c in pat.upper()):
+                raise ValueError(f"{pat_name}={pat!r} contains non-IUPAC characters")
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "RunConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        clean: dict[str, Any] = {}
+        for k, v in d.items():
+            if k in _COMPAT_IGNORED:
+                continue
+            if k not in known:
+                raise ValueError(f"unknown config key: {k!r}")
+            clean[k] = v
+        cfg = cls(**clean)
+        cfg.validate()
+        return cfg
+
+    @classmethod
+    def from_json(cls, path: str | os.PathLike[str]) -> "RunConfig":
+        with open(path) as fh:
+            return cls.from_dict(json.load(fh))
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)

@@ -68,13 +68,19 @@ def test_k_threshold_contract():
 
 
 def test_padding_is_inert():
+    # the same window must give the same result at different pad widths
     rng = np.random.default_rng(3)
     umi = _umi_instance(rng, UMI_FWD)
-    short = "AC" + umi  # well under pad width
-    d1, s1, e1 = _run_batch(UMI_FWD, [short])
-    d2, s2, e2 = _run_batch(UMI_FWD, [short + ""])  # identical
-    assert (d1, s1, e1) == (d2, s2, e2)
-    assert d1[0] == 0 and short[s1[0] : e1[0]] == umi
+    short = "AC" + umi  # well under either pad width
+    pm = encode.encode_mask(UMI_FWD)
+    results = []
+    for pad_to in (128, 256):
+        wm, lens = encode.encode_mask_batch([short], pad_to=pad_to)
+        d, s, e = fuzzy_match.fuzzy_find(pm, wm, lens)
+        results.append((int(d[0]), int(s[0]), int(e[0])))
+    assert results[0] == results[1]
+    d0, s0, e0 = results[0]
+    assert d0 == 0 and short[s0:e0] == umi
 
 
 @pytest.mark.parametrize("pattern", [UMI_FWD, UMI_REV])

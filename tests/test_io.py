@@ -1,0 +1,112 @@
+"""IO layer: fastx round-trips, bucketing, layout, config."""
+
+import numpy as np
+import pytest
+
+from ont_tcrconsensus_tpu.io import bucketing, fastx, layout
+from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+
+
+def test_fastq_roundtrip(tmp_path):
+    path = tmp_path / "r.fastq.gz"
+    recs = [("r1 extra=1", "ACGT", "IIII"), ("r2", "GGTTAA", "!!!!!!")]
+    assert fastx.write_fastq(path, recs) == 2
+    back = list(fastx.read_fastx(path))
+    assert [r.name for r in back] == ["r1", "r2"]
+    assert back[0].comment == "extra=1"
+    assert back[0].header == "r1 extra=1"
+    assert [r.sequence for r in back] == ["ACGT", "GGTTAA"]
+    assert [r.quality for r in back] == ["IIII", "!!!!!!"]
+
+
+def test_fasta_roundtrip_multiline(tmp_path):
+    path = tmp_path / "r.fasta"
+    fastx.write_fasta(path, [("a", "ACGT" * 30), ("b", "TTTT")], width=17)
+    d = fastx.read_fasta_dict(path)
+    assert d == {"a": "ACGT" * 30, "b": "TTTT"}
+    assert fastx.count_fasta_records(path) == 2
+
+
+def test_fastq_stats(tmp_path):
+    path = tmp_path / "r.fastq"
+    fastx.write_fastq(path, [("a", "ACGT", "IIII"), ("b", "AC", "II")])
+    st = fastx.fastq_stats(path)
+    assert st["num_seqs"] == 2
+    assert st["sum_len"] == 6
+    assert st["min_len"] == 2 and st["max_len"] == 4
+    assert st["avg_qual"] == pytest.approx(40.0)
+
+
+def test_bucketing_widths_and_padding():
+    recs = [
+        fastx.FastxRecord("a", "", "A" * 100, "I" * 100),
+        fastx.FastxRecord("b", "", "C" * 300, "I" * 300),
+        fastx.FastxRecord("c", "", "G" * 100, "I" * 100),
+    ]
+    batches = list(bucketing.batch_reads(recs, batch_size=4))
+    by_width = {b.width: b for b in batches}
+    assert set(by_width) == {256, 512}
+    b256 = by_width[256]
+    assert b256.num_valid == 2
+    assert b256.codes.shape == (4, 256)
+    assert list(b256.lengths[:2]) == [100, 100]
+    assert b256.ids[:2] == ["a", "c"]
+    # padding rows are PAD everywhere, qual 93
+    assert (b256.codes[2:] == 5).all()
+    assert (b256.quals[2:] == 93).all()
+
+
+def test_bucketing_drops_out_of_range():
+    recs = [
+        fastx.FastxRecord("short", "", "A" * 3),
+        fastx.FastxRecord("long", "", "A" * 10_000),
+        fastx.FastxRecord("ok", "", "A" * 200),
+    ]
+    batches = list(bucketing.batch_reads(recs, batch_size=8, min_len=10, with_quals=False))
+    assert sum(b.num_valid for b in batches) == 1
+    assert batches[0].ids[0] == "ok"
+
+
+def test_layout_resume(tmp_path):
+    lay = layout.init_library_dir("/x/barcode01.fastq.gz", tmp_path)
+    assert lay.library == "barcode01"
+    for sub in layout.SUBDIRS:
+        assert (tmp_path / "barcode01" / sub).is_dir()
+    with pytest.raises(FileExistsError):
+        layout.init_library_dir("/x/barcode01.fastq.gz", tmp_path)
+    lay2 = layout.init_library_dir("/x/barcode01.fastq.gz", tmp_path, resume=True)
+    lay2.mark_stage_done("align")
+    assert lay2.stage_done("align")
+    assert not lay2.stage_done("umi_extract")
+
+
+def test_config_defaults_and_validation(tmp_path):
+    cfg = RunConfig.from_dict({"reference_file": "ref.fa", "fastq_pass_dir": "fq"})
+    assert cfg.cluster_identity == pytest.approx(0.93)
+    assert cfg.vsearch_identity == 0.93
+
+    with pytest.raises(ValueError, match="unknown config key"):
+        RunConfig.from_dict({"reference_file": "r", "fastq_pass_dir": "f", "typo_key": 1})
+    with pytest.raises(ValueError, match="max_ee_rate_base"):
+        RunConfig.from_dict(
+            {"reference_file": "r", "fastq_pass_dir": "f", "max_ee_rate_base": 2.0}
+        )
+    # reference compat keys are accepted and ignored
+    cfg2 = RunConfig.from_dict(
+        {
+            "reference_file": "r",
+            "fastq_pass_dir": "f",
+            "dorado_excutable": "/opt/dorado",
+            "medaka_model": "r1041_e82_400bps_sup_v5.0.0",
+        }
+    )
+    assert cfg2.reference_file == "r"
+
+
+def test_config_json_roundtrip(tmp_path):
+    import json
+
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"reference_file": "r.fa", "fastq_pass_dir": "fq", "minimal_length": 99}))
+    cfg = RunConfig.from_json(p)
+    assert cfg.minimal_length == 99

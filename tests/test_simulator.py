@@ -1,0 +1,64 @@
+"""Simulator ground-truth sanity: UMIs land in the expected adapter windows."""
+
+import numpy as np
+
+from ont_tcrconsensus_tpu.io import simulator
+
+
+def test_reference_shapes():
+    rng = np.random.default_rng(0)
+    ref = simulator.make_reference(
+        rng, num_regions=4, num_similar_pairs=1, num_negative_controls=1
+    )
+    assert len(ref) == 6
+    assert any(n.endswith("_full_n") for n in ref)
+    sim_names = [n for n in ref if "_sim" in n]
+    assert len(sim_names) == 1
+    src = sim_names[0].split("_sim")[0]
+    a, b = ref[src], ref[sim_names[0]]
+    assert len(a) == len(b)
+    ident = sum(x == y for x, y in zip(a, b)) / len(a)
+    assert 0.97 < ident < 1.0
+
+
+def test_library_ground_truth():
+    lib = simulator.simulate_library(seed=1, num_regions=3, sub_rate=0.0, ins_rate=0.0, del_rate=0.0)
+    assert len(lib.reads) == sum(m.num_reads for m in lib.molecules)
+    # with zero errors, each + read must contain its molecule's exact UMIs in
+    # the head/tail windows the pipeline searches (81 / 76 nt)
+    by_idx = {i: m for i, m in enumerate(lib.molecules)}
+    checked = 0
+    for header, seq, qual in lib.reads:
+        mi = int(header.split("mol=")[1].split()[0])
+        orient = header.split("orient=")[1].split()[0]
+        mol = by_idx[mi]
+        if orient == "-":
+            seq = simulator.revcomp(seq)
+        assert mol.umi_fwd in seq[:81]
+        assert mol.umi_rev in seq[-76:]
+        assert len(qual) == len(seq)
+        checked += 1
+    assert checked > 10
+
+
+def test_error_model_changes_reads():
+    lib0 = simulator.simulate_library(seed=2, num_regions=2, sub_rate=0.0, ins_rate=0.0, del_rate=0.0)
+    lib1 = simulator.simulate_library(seed=2, num_regions=2, sub_rate=0.05, ins_rate=0.02, del_rate=0.02)
+    assert lib0.reference == lib1.reference
+    # same molecules, different read sequences
+    assert [m.combined_umi for m in lib0.molecules] == [m.combined_umi for m in lib1.molecules]
+    assert lib0.reads != lib1.reads
+
+
+def test_qualities_reflect_error_rate():
+    lo = simulator.simulate_library(seed=3, num_regions=2, sub_rate=0.001, ins_rate=0.0005, del_rate=0.0005)
+    hi = simulator.simulate_library(seed=3, num_regions=2, sub_rate=0.05, ins_rate=0.02, del_rate=0.02)
+
+    def mean_q(lib):
+        tot = n = 0
+        for _, _, q in lib.reads[:20]:
+            tot += sum(ord(c) - 33 for c in q)
+            n += len(q)
+        return tot / n
+
+    assert mean_q(lo) > mean_q(hi) + 5

@@ -1,0 +1,115 @@
+"""Banded SW kernel vs full-DP numpy oracle, plus amplicon-geometry cases."""
+
+import numpy as np
+import pytest
+
+from ont_tcrconsensus_tpu.io import simulator
+from ont_tcrconsensus_tpu.ops import encode, sw_align
+
+
+def _pad(seqs, width):
+    out = np.full((len(seqs), width), encode.PAD_CODE, dtype=np.uint8)
+    lens = np.zeros(len(seqs), dtype=np.int32)
+    for i, s in enumerate(seqs):
+        out[i, : len(s)] = s
+        lens[i] = len(s)
+    return out, lens
+
+
+def _run_one(read, ref, offset=0, band=256):
+    reads, rlens = _pad([read], 256)
+    refs, tlens = _pad([ref], 256)
+    res = sw_align.align_banded(
+        reads, rlens, refs, tlens, np.array([offset], np.int32), band_width=band
+    )
+    return {k: int(getattr(res, k)[0]) for k in
+            ("score", "read_start", "read_end", "ref_start", "ref_end", "n_match", "n_cols")}
+
+
+def test_exact_substring():
+    rng = np.random.default_rng(0)
+    ref = rng.integers(0, 4, 80).astype(np.uint8)
+    read = np.concatenate([rng.integers(0, 4, 10), ref, rng.integers(0, 4, 7)]).astype(np.uint8)
+    got = _run_one(read, ref, offset=-10)
+    assert got["score"] == 80 * sw_align.MATCH
+    assert got["n_match"] == 80 and got["n_cols"] == 80
+    assert (got["read_start"], got["read_end"]) == (10, 90)
+    assert (got["ref_start"], got["ref_end"]) == (0, 80)
+
+
+def test_matches_numpy_oracle_random():
+    rng = np.random.default_rng(1)
+    for trial in range(12):
+        n = int(rng.integers(40, 120))
+        m = int(rng.integers(40, 120))
+        # correlated pair: mutate a shared core so a clear local optimum exists
+        core = rng.integers(0, 4, min(n, m)).astype(np.uint8)
+        read = core[:n].copy()
+        ref = core[:m].copy()
+        nmut = int(rng.integers(0, 8))
+        for p in rng.choice(min(n, m), size=nmut, replace=False):
+            ref[p] = (ref[p] + 1 + rng.integers(3)) % 4
+        want = sw_align.align_np(read, ref)
+        got = _run_one(read, ref)
+        assert got["score"] == int(want.score), trial
+        for f in ("read_start", "read_end", "ref_start", "ref_end", "n_match", "n_cols"):
+            assert got[f] == int(getattr(want, f)), (trial, f)
+
+
+def test_matches_numpy_oracle_with_indels():
+    rng = np.random.default_rng(2)
+    for trial in range(8):
+        ref = rng.integers(0, 4, 100).astype(np.uint8)
+        read = list(ref)
+        # random indels + subs
+        for _ in range(5):
+            p = int(rng.integers(len(read)))
+            op = rng.integers(3)
+            if op == 0:
+                read.insert(p, int(rng.integers(4)))
+            elif op == 1 and len(read) > 10:
+                del read[p]
+            else:
+                read[p] = (read[p] + 1) % 4
+        read = np.array(read, dtype=np.uint8)
+        want = sw_align.align_np(read, ref)
+        got = _run_one(read, ref)
+        assert got["score"] == int(want.score), trial
+        assert got["n_cols"] == int(want.n_cols), trial
+        assert got["n_match"] == int(want.n_match), trial
+
+
+def test_amplicon_geometry():
+    """Full amplicon read vs its region: band must absorb flank+UMI overhangs."""
+    rng = np.random.default_rng(3)
+    region = simulator._rand_seq(rng, 1500)
+    umi_f = simulator.instantiate_iupac(rng, "TTTVVTTVVVVTTVVVVTTVVVVTTVVVVTTT")
+    umi_r = simulator.instantiate_iupac(rng, "AAABBBBAABBBBAABBBBAABBBBAABBAAA")
+    full = simulator.LEFT_FLANK + umi_f + region + umi_r + simulator.RIGHT_FLANK
+    read_str, _ = simulator.mutate(rng, full, 0.01, 0.005, 0.005)
+    read = encode.encode_seq(read_str)
+    ref = encode.encode_seq(region)
+    reads, rlens = _pad([read], 2048)
+    refs, tlens = _pad([ref], 2048)
+    overhang = len(simulator.LEFT_FLANK) + len(umi_f)
+    res = sw_align.align_banded(
+        reads, rlens, refs, tlens, np.array([-overhang], np.int32), band_width=256
+    )
+    ref_cov = (int(res.ref_end[0]) - int(res.ref_start[0])) / len(region)
+    assert ref_cov > 0.99
+    assert float(res.blast_id[0]) > 0.96
+    # softclips bounded by flank+UMI sizes (plus indel slack)
+    assert int(res.read_start[0]) <= overhang + 10
+    assert len(read) - int(res.read_end[0]) <= overhang + 10
+
+
+def test_batch_is_elementwise():
+    rng = np.random.default_rng(4)
+    seqs = [rng.integers(0, 4, int(rng.integers(50, 120))).astype(np.uint8) for _ in range(6)]
+    refs_l = [rng.integers(0, 4, int(rng.integers(50, 120))).astype(np.uint8) for _ in range(6)]
+    reads, rlens = _pad(seqs, 128)
+    refs, tlens = _pad(refs_l, 128)
+    res = sw_align.align_banded(reads, rlens, refs, tlens, np.zeros(6, np.int32))
+    for i in range(6):
+        got = _run_one(seqs[i], refs_l[i])
+        assert int(res.score[i]) == got["score"]
