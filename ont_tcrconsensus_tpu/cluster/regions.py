@@ -1,0 +1,167 @@
+"""Reference self-homology mapping and region clustering.
+
+TPU-native rebuild of the reference's PAF-driven region clustering
+(/root/reference/ont_tcr_consensus/region_split.py:61-216 fed by
+minimap2_align.py:40-73): reads are consensus-polished within groups of
+indistinguishable reference regions, and the final blast-id filter defaults
+to the *highest inter-reference similarity* so surviving consensus maps
+uniquely (the pipeline's precision contract, SURVEY §3.2).
+
+Pipeline here: hashed k-mer profile cosine matrix on the MXU (prefilter,
+replaces minimap2 seeding) -> banded SW on the shortlisted pairs
+(:mod:`..ops.sw_align`) -> the reference's own filters and greedy clustering,
+replicated exactly:
+
+- pairs kept iff alignment block length > 0.99 * min(len_a, len_b)
+  (region_split.py:114-117),
+- symmetric pairs deduplicated (:121-129),
+- per query the most-similar partner by blast identity (:132-137),
+- greedy clustering over tuples sorted by similarity desc (:61-82).
+
+Divergence (documented): if NO pair survives the 0.99-overlap filter the
+reference crashes on ``np.max([])`` (region_split.py:216); here the returned
+``max_blast_id`` is None and the caller falls back to a configured default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ont_tcrconsensus_tpu.ops import encode, sketch, sw_align
+
+NEGATIVE_CONTROL_SUFFIXES = ("_v_n", "cdr3j_n", "full_n")  # region_split.py:305
+
+
+@dataclasses.dataclass
+class HomologyResult:
+    region_cluster: dict[str, int]        # region name -> cluster index
+    most_similar: list[tuple[str, str, float]]  # (query, partner, blast_id)
+    max_blast_id: float | None            # the dynamic precision bar
+    stats: dict[str, float]               # QC log values (SURVEY §5)
+
+
+def greedy_most_similar_clustering(
+    tuples: list[tuple[str, str, float]], similarity_threshold: float
+) -> list[set[str]]:
+    """Exact replica of the reference's greedy single-link pass
+    (region_split.py:61-82), including its quirks: sub-threshold pairs of
+    two unseen regions are skipped without marking them seen, and a pair
+    touching an existing cluster joins the *first* cluster containing
+    either region."""
+    sorted_data = sorted(tuples, key=lambda x: x[2], reverse=True)
+    clusters: list[set[str]] = []
+    seen: set[str] = set()
+    for a, b, sim in sorted_data:
+        if a not in seen and b not in seen:
+            if sim >= similarity_threshold:
+                clusters.append({a, b})
+                seen.update([a, b])
+        elif a in seen or b in seen:
+            for cluster in clusters:
+                if a in cluster or b in cluster:
+                    if sim >= similarity_threshold:
+                        cluster.update([a, b])
+                        seen.update([a, b])
+                    break
+    return clusters
+
+
+def self_homology_map(
+    reference: dict[str, str],
+    cluster_threshold: float,
+    prefilter_cosine: float = 0.12,
+    band_width: int = 512,
+    sketch_k: int = 8,
+    sketch_dim: int = 4096,
+    pair_batch: int = 256,
+) -> HomologyResult:
+    """All-vs-all reference homology -> region clusters + precision bar.
+
+    Args:
+      reference: {region name: sequence}.
+      cluster_threshold: blast-id above which regions share a cluster
+        (the reference passes 1 - max_ee_rate_base, tcr_consensus.py:68).
+    """
+    names = list(reference)
+    seqs = [reference[n] for n in names]
+    R = len(names)
+    if R == 0:
+        return HomologyResult({}, [], None, {"num_pairs_prefilter": 0})
+    max_len = max(len(s) for s in seqs)
+    codes, lens = encode.encode_batch(seqs, pad_to=max_len)
+    profiles = sketch.kmer_profile(codes, lens, k=sketch_k, dim=sketch_dim)
+    sim = np.asarray(sketch.similarity_matrix(profiles, profiles))
+
+    ii, jj = np.where(np.triu(sim, k=1) >= prefilter_cosine)
+    tuples: list[tuple[str, str, float]] = []
+    if len(ii):
+        # banded SW on the shortlist, batched
+        blast_ids = np.zeros(len(ii), dtype=np.float64)
+        block_lens = np.zeros(len(ii), dtype=np.int64)
+        offs = sketch.diag_offset(lens[ii], lens[jj]).astype(np.int32)
+        for s in range(0, len(ii), pair_batch):
+            sl = slice(s, min(s + pair_batch, len(ii)))
+            res = sw_align.align_banded(
+                codes[ii[sl]], lens[ii[sl]], codes[jj[sl]], lens[jj[sl]],
+                offs[sl], band_width=band_width,
+            )
+            blast_ids[sl] = np.asarray(res.blast_id)
+            block_lens[sl] = np.asarray(res.n_cols)
+        # reference filter: alignment block > 0.99 * min length
+        min_len = np.minimum(lens[ii], lens[jj])
+        keep = block_lens > 0.99 * min_len
+        # per query (smaller index plays minimap2's query role) keep the
+        # most-similar partner (region_split.py:132-137)
+        best: dict[int, tuple[int, float]] = {}
+        for qi, ti, bid in zip(ii[keep], jj[keep], blast_ids[keep]):
+            cur = best.get(qi)
+            if cur is None or bid > cur[1]:
+                best[qi] = (ti, bid)
+        tuples = [(names[q], names[t], float(b)) for q, (t, b) in sorted(best.items())]
+
+    clusters = greedy_most_similar_clustering(tuples, cluster_threshold)
+    region_cluster: dict[str, int] = {}
+    idx = 0
+    for cl in clusters:
+        for region in cl:
+            region_cluster[region] = idx
+        idx += 1
+    for region in names:  # singletons, in reference order
+        if region not in region_cluster:
+            region_cluster[region] = idx
+            idx += 1
+
+    bids = [t[2] for t in tuples]
+    stats = {
+        "num_pairs_prefilter": int(len(ii)),
+        "num_most_similar_pairs": len(tuples),
+        "num_region_clusters": idx,
+    }
+    if bids:
+        stats.update({
+            "median_blast_id": float(np.median(bids)),
+            "q925_blast_id": float(np.quantile(bids, 0.925)),
+            "q950_blast_id": float(np.quantile(bids, 0.950)),
+            "q975_blast_id": float(np.quantile(bids, 0.975)),
+            "q990_blast_id": float(np.quantile(bids, 0.990)),
+            "max_blast_id": float(np.max(bids)),
+        })
+    return HomologyResult(
+        region_cluster=region_cluster,
+        most_similar=tuples,
+        max_blast_id=float(np.max(bids)) if bids else None,
+        stats=stats,
+    )
+
+
+def region_length_dict(reference: dict[str, str]) -> dict[str, int]:
+    """region_split.py:52-58 analogue."""
+    return {name: len(seq) for name, seq in reference.items()}
+
+
+def countable_regions(reference: dict[str, str]) -> set[str]:
+    """Regions that count toward detection stats — negative controls
+    excluded (region_split.py:302-309)."""
+    return {n for n in reference if not n.endswith(NEGATIVE_CONTROL_SUFFIXES)}

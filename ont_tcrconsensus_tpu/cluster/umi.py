@@ -1,0 +1,159 @@
+"""Greedy centroid UMI clustering driven by device distance batches.
+
+TPU-native replacement for ``vsearch --cluster_fast`` on combined UMIs
+(/root/reference/ont_tcr_consensus/vsearch_umi_cluster.py:21-54 round 1 at
+id 0.93, :59-97 round 2 at id 0.97). vsearch's exact behavior is
+input-order- and heuristic-dependent (kmer-ranked candidate scan,
+maxaccepts/maxrejects); SURVEY §7 "hard parts" #1 allows an equivalent,
+*deterministic* policy with equivalence asserted at the UMI-counts level:
+
+1. exact-duplicate UMIs collapse first (hash map, host);
+2. unique UMIs get k-mer count profiles; a tiled MXU matmul ranks the
+   ``shortlist_k`` nearest uniques per unique (replaces vsearch's kmer
+   prefilter);
+3. exact batched NW edit distances (:mod:`..ops.edit_distance`) refine the
+   shortlist into an identity graph;
+4. a host greedy pass in vsearch's processing order (length desc, then
+   first-occurrence asc — cluster_fast's length sort) assigns each unique
+   to the highest-identity existing centroid >= the threshold (ties: the
+   earliest-created centroid), else founds a new centroid.
+
+Identity = 1 - d/max(len_a, len_b) (documented divergence from vsearch
+--iddef 2; see edit_distance module docstring).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ont_tcrconsensus_tpu.ops import edit_distance, encode, sketch
+
+
+@dataclasses.dataclass
+class UmiClusters:
+    labels: np.ndarray            # (N,) int32 cluster id per input sequence
+    num_clusters: int
+    centroid_of: np.ndarray       # (num_clusters,) input index of each centroid
+
+    def members(self, cluster_id: int) -> np.ndarray:
+        return np.where(self.labels == cluster_id)[0]
+
+
+def cluster_umis(
+    umis: list[str],
+    identity_threshold: float,
+    shortlist_k: int = 32,
+    kmer_k: int = 4,
+    pair_batch: int = 65536,
+    pad_width: int = 128,
+) -> UmiClusters:
+    """Cluster combined-UMI strings; returns per-input labels.
+
+    Deterministic for a fixed input list. Centroid ids are dense, ordered by
+    creation (vsearch writes clusters in the same creation order).
+    """
+    N = len(umis)
+    if N == 0:
+        return UmiClusters(np.zeros(0, np.int32), 0, np.zeros(0, np.int32))
+
+    # 1. collapse exact duplicates
+    first_idx: dict[str, int] = {}
+    uniq: list[str] = []
+    inverse = np.zeros(N, dtype=np.int32)
+    for i, u in enumerate(umis):
+        j = first_idx.get(u)
+        if j is None:
+            j = len(uniq)
+            first_idx[u] = j
+            uniq.append(u)
+        inverse[i] = j
+    U = len(uniq)
+
+    codes, lens = encode.encode_batch(uniq, pad_to=pad_width)
+    order = sorted(range(U), key=lambda u: (-len(uniq[u]), u))
+
+    if U == 1:
+        ulabels = np.zeros(1, np.int32)
+        centroids = np.array([0], np.int32)
+    else:
+        neigh_idx, neigh_ident = _neighbor_identities(
+            codes, lens, shortlist_k=min(shortlist_k, U - 1), kmer_k=kmer_k,
+            pair_batch=pair_batch,
+        )
+        ulabels, centroids = _greedy_assign(order, neigh_idx, neigh_ident, identity_threshold)
+
+    labels = ulabels[inverse]
+    # map centroid unique-indices back to their first occurrence in the input
+    uniq_to_input = np.full(U, -1, dtype=np.int32)
+    for i in range(N):
+        j = inverse[i]
+        if uniq_to_input[j] < 0:
+            uniq_to_input[j] = i
+    return UmiClusters(
+        labels=labels.astype(np.int32),
+        num_clusters=int(labels.max()) + 1 if N else 0,
+        centroid_of=uniq_to_input[centroids],
+    )
+
+
+def _neighbor_identities(codes, lens, shortlist_k, kmer_k, pair_batch):
+    """(U, K) nearest-unique shortlist + exact identities, device-computed."""
+    U = codes.shape[0]
+    profiles = np.asarray(sketch.kmer_profile(codes, lens, k=kmer_k, dim=None))
+    # tiled top-(k+1) against all uniques; drop the self column vectorized:
+    # each row holds at most one self hit, so skipping its position (or the
+    # trailing extra column when absent) leaves exactly shortlist_k entries
+    neigh = np.zeros((U, shortlist_k), dtype=np.int32)
+    tile = max(1, min(4096, U))
+    for s in range(0, U, tile):
+        e = min(s + tile, U)
+        idx = np.asarray(sketch.top_candidates(profiles[s:e], profiles, shortlist_k + 1))
+        rows = np.arange(s, e)[:, None]
+        is_self = idx == rows
+        self_pos = np.where(
+            is_self.any(axis=1), is_self.argmax(axis=1), shortlist_k
+        )[:, None]
+        cols = np.arange(shortlist_k)[None, :]
+        cols = cols + (cols >= self_pos)
+        neigh[s:e] = np.take_along_axis(idx, cols, axis=1)
+    # exact distances on the (U * K) pair list
+    qi = np.repeat(np.arange(U, dtype=np.int32), shortlist_k)
+    ti = neigh.reshape(-1)
+    ident = np.zeros(U * shortlist_k, dtype=np.float32)
+    for s in range(0, len(qi), pair_batch):
+        sl = slice(s, min(s + pair_batch, len(qi)))
+        d = np.asarray(
+            edit_distance.pairwise(codes[qi[sl]], lens[qi[sl]], codes[ti[sl]], lens[ti[sl]])
+        ).astype(np.float32)
+        longest = np.maximum(lens[qi[sl]], lens[ti[sl]]).astype(np.float32)
+        ident[sl] = np.where(longest > 0, 1.0 - d / np.maximum(longest, 1.0), 0.0)
+    ident = ident.reshape(U, shortlist_k)
+    ident[neigh == np.arange(U)[:, None]] = -1.0  # safety: never self-join
+    return neigh, ident
+
+
+def _greedy_assign(order, neigh_idx, neigh_ident, threshold):
+    """Host greedy pass; see module docstring for the policy."""
+    U = len(order)
+    labels = np.full(U, -1, dtype=np.int32)
+    centroid_rank: dict[int, int] = {}  # unique idx -> creation order
+    centroids: list[int] = []
+    for u in order:
+        best_c = -1
+        best_ident = -1.0
+        for t, ident in zip(neigh_idx[u], neigh_ident[u]):
+            rank = centroid_rank.get(int(t))
+            if rank is None or ident < threshold:
+                continue
+            if ident > best_ident or (ident == best_ident and rank < best_c):
+                best_ident = float(ident)
+                best_c = rank
+        if best_c >= 0:
+            labels[u] = best_c
+        else:
+            centroid_rank[u] = len(centroids)
+            labels[u] = len(centroids)
+            centroids.append(u)
+    return labels, np.array(centroids, dtype=np.int32)

@@ -68,13 +68,23 @@ def read_fastx(path: str | os.PathLike[str]) -> Iterator[FastxRecord]:
             yield FastxRecord(name, comment, "".join(seq_parts))
         elif first == "@":
             header = "@" + fh.readline()
-            while header.strip():
+            while header:
+                if not header.strip():  # tolerate blank lines between records
+                    header = fh.readline()
+                    continue
                 name, comment = _split_header(header)
                 seq = fh.readline().strip()
                 plus = fh.readline()
                 qual = fh.readline().strip()
                 if not plus.startswith("+"):
                     raise ValueError(f"malformed FASTQ record near {name!r} in {path}")
+                if not qual and seq:
+                    raise ValueError(f"truncated FASTQ record {name!r} in {path}")
+                if len(qual) != len(seq):
+                    raise ValueError(
+                        f"FASTQ record {name!r} in {path}: qual length "
+                        f"{len(qual)} != seq length {len(seq)}"
+                    )
                 yield FastxRecord(name, comment, seq, qual)
                 header = fh.readline()
         else:

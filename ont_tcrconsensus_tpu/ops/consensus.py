@@ -1,0 +1,203 @@
+"""Iterative pileup-vote consensus (the spoa/medaka-draft replacement).
+
+The reference builds per-UMI-cluster drafts with spoa's POA graph and
+polishes them with medaka's RNN (/root/reference/ont_tcr_consensus/
+medaka_polish.py:113-134). POA is graph-shaped and irregular — hostile to
+XLA — so this module uses the banded-DP-on-padded-batches reformulation
+SURVEY §7 anticipates ("hard parts" #3): star alignment against a draft +
+per-column majority vote, iterated. Each round: align all subreads to the
+current draft (:mod:`.pileup`), vote per column over {A,C,G,T,deletion} and
+over single-base insertions, splice the winners in, repeat. With
+same-molecule subreads (>= ~4x depth) two rounds converge to the true
+sequence at ONT error rates; the Flax polisher (:mod:`..models.polisher`)
+then consumes the final pileup counts for extra precision.
+
+Vote semantics (deterministic): per column the plurality of covering
+subreads wins; ties prefer a base over a deletion and the
+smaller base code. An insertion is spliced when strictly more than half of
+the covering subreads report one; the inserted base is the plurality
+``ins_base`` (ties: smaller code).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ont_tcrconsensus_tpu.ops import pileup
+from ont_tcrconsensus_tpu.ops.encode import PAD_CODE
+
+
+@functools.partial(jax.jit, static_argnames=())
+def vote_columns(
+    base_at: jax.Array,
+    ins_cnt: jax.Array,
+    ins_base: jax.Array,
+    draft: jax.Array,
+    draft_len: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """One voting round; returns (new_draft (2*Ld,), new_len).
+
+    The output interleaves kept/substituted draft positions with voted
+    insertions (slot 2j = position j, slot 2j+1 = insertion after j),
+    then compacts; deletions drop their slot.
+    """
+    S, Ld = base_at.shape
+    covered = base_at != pileup.UNCOVERED  # (S, Ld)
+    depth = jnp.sum(covered, axis=0)  # (Ld,)
+
+    # per-column votes over {A,C,G,T,del}
+    counts = jnp.stack(
+        [jnp.sum(base_at == code, axis=0) for code in range(5)], axis=0
+    )  # (5, Ld)
+    # tie-breaks: bases (smaller code) beat deletion on ties -> argmax over
+    # counts with del slightly disadvantaged via lexicographic trick
+    order_bonus = jnp.array([4, 3, 2, 1, 0], jnp.int32)[:, None]  # prefer A<C<G<T<del
+    winner = jnp.argmax(counts * 8 + order_bonus, axis=0).astype(jnp.uint8)  # (Ld,)
+    in_draft = jnp.arange(Ld) < draft_len
+    # uncovered positions keep the draft base verbatim (even N); only a voted
+    # deletion at a covered position drops a slot
+    keep_base = jnp.where(depth > 0, winner, draft[:Ld].astype(jnp.uint8))
+    slot_base = jnp.where(in_draft, keep_base, jnp.uint8(PAD_CODE))
+    slot_keep = in_draft & ~((depth > 0) & (winner == pileup.DELETION))
+
+    # insertion vote: strict majority of covering subreads
+    has_ins = jnp.sum((ins_cnt > 0) & covered, axis=0)
+    do_ins = (has_ins * 2 > depth) & (depth > 0) & in_draft
+    ins_counts = jnp.stack(
+        [jnp.sum((ins_base == code) & (ins_cnt > 0) & covered, axis=0) for code in range(4)],
+        axis=0,
+    )
+    ins_winner = jnp.argmax(ins_counts * 8 + order_bonus[:4], axis=0).astype(jnp.uint8)
+
+    # interleave and compact
+    slots = jnp.stack([slot_base, jnp.where(do_ins, ins_winner, PAD_CODE)], axis=1).reshape(-1)
+    keep = jnp.stack([slot_keep, do_ins], axis=1).reshape(-1)
+    new_len = jnp.sum(keep).astype(jnp.int32)
+    pos = jnp.cumsum(keep) - 1
+    out = jnp.full((2 * Ld,), PAD_CODE, jnp.uint8)
+    # non-kept slots scatter out of bounds and are dropped
+    out = out.at[jnp.where(keep, pos, 2 * Ld)].set(slots, mode="drop")
+    return out, new_len
+
+
+def _extend_ends(draft, draft_len, subreads, subread_lens, spans, aligned_draft_len):
+    """Majority-vote single-base extension at each draft end.
+
+    A local alignment cannot report insertions before draft position 0 (or
+    after the last position): a seed draft that eroded a terminal base would
+    never recover it from the pileup alone. Among subreads whose alignment
+    reaches the draft boundary, a strict majority carrying extra read bases
+    beyond it votes the plurality base onto the end (one base per round;
+    iteration regrows deeper erosion).
+    """
+    spans = np.asarray(spans)
+    r_start, r_end, f_start, f_end = spans[:, 0], spans[:, 1], spans[:, 2], spans[:, 3]
+
+    # left end
+    at_left = f_start == 0
+    has_more = at_left & (r_start > 0)
+    if at_left.sum() and has_more.sum() * 2 > at_left.sum() and draft_len < draft.shape[0]:
+        bases = subreads[has_more, np.maximum(r_start[has_more] - 1, 0)]
+        bc = np.bincount(bases[bases < 4], minlength=4)
+        if bc.sum():
+            draft = np.concatenate([[np.uint8(bc.argmax())], draft[:-1]])
+            draft_len += 1
+    # right end (spans were computed against the pre-vote draft)
+    at_right = f_end == aligned_draft_len
+    has_more = at_right & (r_end < subread_lens)
+    if at_right.sum() and has_more.sum() * 2 > at_right.sum():
+        idx = np.minimum(r_end[has_more], subreads.shape[1] - 1)
+        bases = subreads[has_more, idx]
+        bc = np.bincount(bases[bases < 4], minlength=4)
+        if bc.sum() and draft_len < draft.shape[0]:
+            draft = draft.copy()
+            draft[draft_len] = np.uint8(bc.argmax())
+            draft_len += 1
+    return draft, draft_len
+
+
+def consensus_cluster(
+    subreads: np.ndarray,
+    subread_lens: np.ndarray,
+    rounds: int = 4,
+    band_width: int = 128,
+    pad_to: int | None = None,
+) -> tuple[np.ndarray, int]:
+    """Host driver: consensus of one UMI cluster's subreads.
+
+    Args:
+      subreads: (S, L) uint8 dense codes, all in canonical (+) orientation —
+        orientation is known from the alignment stage, unlike medaka which
+        must re-orient internally.
+      subread_lens: (S,)
+      rounds: maximum align->vote rounds; stops early once the draft is a
+        fixed point.
+
+    Returns (consensus_codes (width,) padded, consensus_len).
+
+    Draft seed: the subread of median length (stable pick: lower median),
+    mirroring "a representative read" rather than spoa's MSA seed.
+    """
+    S, L = subreads.shape
+    real = np.where(np.asarray(subread_lens) > 0)[0]  # callers pad with 0-length rows
+    if len(real) == 0:
+        return np.full((int(pad_to or L),), PAD_CODE, np.uint8), 0
+    order = real[np.argsort(np.asarray(subread_lens)[real], kind="stable")]
+    seed = int(order[(len(real) - 1) // 2])
+    width = int(pad_to or L)
+    draft = np.full((width,), PAD_CODE, np.uint8)
+    n = int(subread_lens[seed])
+    draft[:n] = subreads[seed, :n]
+    draft_len = np.int32(n)
+
+    offsets = np.zeros((S,), np.int32)
+    for _ in range(rounds):
+        base_at, ins_cnt, ins_base, spans = pileup.pileup_columns(
+            subreads, subread_lens, jnp.asarray(draft), jnp.asarray(draft_len),
+            offsets, band_width=band_width, out_len=width,
+        )
+        new_draft, new_len = vote_columns(
+            base_at, ins_cnt, ins_base, jnp.asarray(draft), jnp.asarray(draft_len)
+        )
+        new_len = int(new_len)
+        if new_len > width:
+            raise ValueError("consensus grew past the padded width")
+        cand = np.full((width,), PAD_CODE, np.uint8)
+        cand[:width] = np.asarray(new_draft)[:width]
+        cand, new_len = _extend_ends(
+            cand, new_len, subreads, subread_lens, spans, int(draft_len)
+        )
+        unchanged = new_len == draft_len and (cand[:new_len] == draft[:new_len]).all()
+        draft = cand
+        draft_len = np.int32(new_len)
+        if unchanged:
+            break
+    return draft, int(draft_len)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def pileup_features(
+    base_at: jax.Array, ins_cnt: jax.Array, draft: jax.Array
+) -> jax.Array:
+    """(S, Ld) columns -> (Ld, 11) float32 polisher features.
+
+    Channels: A/C/G/T/del counts (5), insertion-reporting count (1), depth
+    (1), all log1p-scaled; draft base one-hot (4); normalized position-free.
+    Mirrors medaka's counts-matrix feature family (its pileup counts
+    encoding), not its exact layout — our polisher is trained in-repo.
+    """
+    S, Ld = base_at.shape
+    covered = base_at != pileup.UNCOVERED
+    counts = jnp.stack(
+        [jnp.sum(base_at == code, axis=0) for code in range(5)], axis=1
+    ).astype(jnp.float32)  # (Ld, 5)
+    ins = jnp.sum((ins_cnt > 0) & covered, axis=0).astype(jnp.float32)[:, None]
+    depth = jnp.sum(covered, axis=0).astype(jnp.float32)[:, None]
+    draft_oh = jax.nn.one_hot(jnp.minimum(draft[:Ld], 4), 4, dtype=jnp.float32)
+    return jnp.concatenate(
+        [jnp.log1p(counts), jnp.log1p(ins), jnp.log1p(depth), draft_oh], axis=1
+    )

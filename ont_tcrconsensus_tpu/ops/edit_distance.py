@@ -18,8 +18,6 @@ UMI-counts level by the end-to-end tests instead of per-alignment.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 
@@ -82,33 +80,4 @@ def identity_matrix(queries, q_lens, targets, t_lens):
     return jnp.where(either_empty, 0.0, ident)
 
 
-def kmer_profile(codes: jax.Array, lengths: jax.Array, k: int = 4) -> jax.Array:
-    """(B, L) dense codes -> (B, 4^k) float32 k-mer count profiles.
-
-    The MXU prefilter for clustering and candidate selection: profile dot
-    products rank likely near-duplicates so the exact DP only runs on a
-    short-list. Padding and N bases contribute to no k-mer.
-    """
-    B, L = codes.shape
-    c = codes.astype(jnp.int32)
-    valid = (c < 4) & (jnp.arange(L)[None, :] < lengths[:, None])
-    idx = jnp.zeros((B, L - k + 1), dtype=jnp.int32)
-    ok = jnp.ones((B, L - k + 1), dtype=bool)
-    for off in range(k):
-        idx = idx * 4 + c[:, off : L - k + 1 + off]
-        ok = ok & valid[:, off : L - k + 1 + off]
-    idx = jnp.where(ok, idx, 4**k)  # out-of-range bucket, dropped below
-    one_hot = jax.nn.one_hot(idx, 4**k + 1, dtype=jnp.float32)
-    return jnp.sum(one_hot, axis=1)[:, : 4**k]
-
-
-@functools.partial(jax.jit, static_argnames=("top_k",))
-def top_candidates(q_profiles, t_profiles, top_k: int):
-    """Rank targets by k-mer profile similarity, return (Q, top_k) indices.
-
-    Similarity is the min-count kernel approximated by the dot product on
-    the MXU; exact DP refinement happens on the short-list only.
-    """
-    scores = q_profiles @ t_profiles.T  # (Q, T) on the MXU
-    _, idx = jax.lax.top_k(scores, top_k)
-    return idx
+# k-mer profile prefilters live in :mod:`.sketch` (exact mode: dim=None).

@@ -1,0 +1,240 @@
+"""Banded alignment with traceback -> per-column pileups on device.
+
+The consensus stage needs *per-draft-position* alignment columns (which base
+of each subread sits over draft position j, what is inserted after j), i.e.
+what the reference gets from medaka's spoa POA graph + subread re-alignment
+(/root/reference/ont_tcr_consensus/medaka_polish.py:113-134). The stats-only
+kernel (:mod:`.sw_align`) cannot provide that, so this kernel stores per-cell
+direction planes in the band during the forward scan and walks them back with
+a ``lax.while_loop`` (vmapped over subreads; SURVEY §7 "hard parts" #3/#6).
+
+Per-cell planes (band-shaped, (rows, W)):
+- ``tdir`` uint8: bits 0-1 = tmp choice (0 diag, 1 read-gap/E, 3 fresh/stop);
+  bit 2 = diag predecessor was a fresh start (emit, then stop);
+  bit 3 = the E value here OPENED from H (vs extended from the E above).
+- ``fjump`` uint8: 0 if H == tmp at this cell, else the ref-gap run length m
+  (H chose F; predecessor is tmp at band slot b - m in the same row).
+
+Traceback emits, per subread: ``base_at[j]`` (0-3 base, 4 deletion,
+5 uncovered), ``ins_cnt[j]``/``ins_base[j]`` (insertion run length after
+draft position j and its first base). These feed :mod:`.consensus`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ont_tcrconsensus_tpu.ops.sw_align import (
+    GAP_EXT,
+    GAP_OPEN,
+    MATCH,
+    MISMATCH,
+    NEG,
+    _pairmax,
+    _shift_up,
+)
+
+UNCOVERED = 5
+DELETION = 4
+
+_DIAG, _EGAP, _FRESH = 0, 1, 3
+_DIAG_STOP_BIT = 0b100
+_EOPEN_BIT = 0b1000
+
+
+def _forward_banded(read, read_len, ref, ref_len, diag_offset, band_width, scoring):
+    """Banded local DP; returns (best=(score, i, b), tdir, fjump) planes."""
+    match, mismatch, gap_open, gap_ext = scoring
+    W = band_width
+    c = W // 2
+    L = read.shape[0]
+    Lr = ref.shape[0]
+    iota = jnp.arange(W, dtype=jnp.int32)
+    read_len = read_len.astype(jnp.int32)
+    ref_len = ref_len.astype(jnp.int32)
+    off = diag_offset.astype(jnp.int32)
+
+    shift_up = _shift_up
+    pairmax = _pairmax
+
+    def row_step(carry, i):
+        H, E, best = carry
+        jrow = i + off - c + iota
+        valid = (jrow >= 0) & (jrow < ref_len) & (i < read_len)
+        rbase = read[jnp.clip(i, 0, L - 1)]
+        tbase = ref[jnp.clip(jrow, 0, Lr - 1)]
+        is_match = (tbase == rbase) & (rbase < 4) & (tbase < 4)
+        sub = jnp.where(is_match, match, -mismatch).astype(jnp.int32)
+
+        H_up = shift_up(H, NEG)
+        E_up = shift_up(E, NEG)
+        open_sc = H_up - gap_open - gap_ext
+        ext_sc = E_up - gap_ext
+        e_open = open_sc >= ext_sc
+        E_new = jnp.where(e_open, open_sc, ext_sc)
+
+        fresh_pred = 0 > H
+        D = jnp.where(fresh_pred, 0, H) + sub
+
+        tmp = D
+        tdir = jnp.where(fresh_pred, jnp.uint8(_DIAG | _DIAG_STOP_BIT), jnp.uint8(_DIAG))
+        e_better = E_new > tmp
+        tmp = jnp.where(e_better, E_new, tmp)
+        tdir = jnp.where(e_better, jnp.uint8(_EGAP), tdir)
+        fresh_better = 0 > tmp
+        tmp = jnp.where(fresh_better, 0, tmp)
+        tdir = jnp.where(fresh_better, jnp.uint8(_FRESH), tdir)
+        tmp = jnp.where(valid, tmp, NEG)
+        tdir = tdir | jnp.where(e_open, jnp.uint8(_EOPEN_BIT), jnp.uint8(0))
+
+        g = jnp.where(tmp <= NEG // 2, NEG, tmp + gap_ext * iota)
+        gmax, gidx = jax.lax.associative_scan(pairmax, (g, iota))
+        gmax = jnp.concatenate([jnp.full((1,), NEG, jnp.int32), gmax[:-1]])
+        gidx = jnp.concatenate([jnp.zeros((1,), jnp.int32), gidx[:-1]])
+        F = gmax - gap_open - gap_ext * iota
+
+        take_f = F > tmp
+        H_new = jnp.where(valid, jnp.where(take_f, F, tmp), NEG)
+        fjump = jnp.where(take_f, (iota - gidx).astype(jnp.uint8), jnp.uint8(0))
+
+        b_star = jnp.argmax(H_new).astype(jnp.int32)
+        row_best = H_new[b_star]
+        improve = row_best > best[0]
+        best = jnp.where(improve, jnp.stack([row_best, i, b_star]), best)
+        E_new = jnp.where(valid, E_new, NEG)
+        return (H_new, E_new, best), (tdir, fjump)
+
+    H0 = jnp.full((W,), NEG, jnp.int32)
+    best0 = jnp.array([0, -1, 0], jnp.int32)
+    (_, _, best), (tdir, fjump) = jax.lax.scan(
+        row_step, (H0, H0, best0), jnp.arange(L, dtype=jnp.int32)
+    )
+    return best, tdir, fjump
+
+
+def _traceback_one(best, tdir, fjump, read, diag_offset, band_width, out_len):
+    """Walk the direction planes from the best cell, emitting pileup columns.
+
+    Kernel cell (row i, slot b) has consumed read[0..i] / ref[0..jrow], so a
+    diag emits read[i] over draft position jrow, an E-step emits read[i]
+    inserted after draft position jrow, and an F-run of length m deletes
+    draft positions jrow-m+1..jrow.
+    """
+    W = band_width
+    c = W // 2
+    off = diag_offset.astype(jnp.int32)
+    L = read.shape[0]
+
+    base_at0 = jnp.full((out_len,), UNCOVERED, jnp.uint8)
+    ins_cnt0 = jnp.zeros((out_len,), jnp.int32)
+    ins_base0 = jnp.zeros((out_len,), jnp.uint8)
+
+    score, i0, b0 = best[0], best[1], best[2]
+    jend = i0 + off - c + b0
+    # H mode honours an F-jump at the cell; TMP mode (the landing state of an
+    # F-run — F's predecessor is tmp, which excludes F) does not; E mode is
+    # inside a read-gap chain.
+    MODE_H, MODE_E, MODE_TMP = jnp.int32(0), jnp.int32(1), jnp.int32(2)
+
+    # state: (i, b, mode, pending_del, done, base_at, ins_cnt, ins_base,
+    #         read_start, ref_start) — the *_start fields track the smallest
+    # read / draft position the path consumed (emitted) so far.
+    def cond(state):
+        return ~state[4]
+
+    def step(state):
+        i, b, mode, pending, done, base_at, ins_cnt, ins_base, rstart, fstart = state
+        jrow = i + off - c + b
+        jc = jnp.clip(jrow, 0, out_len - 1)
+        j_ok = (jrow >= 0) & (jrow < out_len)
+        rb = read[jnp.clip(i, 0, L - 1)]
+        rb_known = rb < 4  # an N aligned over a column carries no base vote
+        d = tdir[jnp.clip(i, 0, tdir.shape[0] - 1), jnp.clip(b, 0, W - 1)]
+        m = fjump[jnp.clip(i, 0, fjump.shape[0] - 1), jnp.clip(b, 0, W - 1)].astype(jnp.int32)
+
+        # 1. pending deletion run: emit one deletion, move left
+        in_del = pending > 0
+        # 2. otherwise, entering cell in H mode with an F-jump: start a run
+        start_del = ~in_del & (mode == MODE_H) & (m > 0)
+        do_del = in_del | start_del
+        new_pending = jnp.where(in_del, pending - 1, jnp.where(start_del, m - 1, 0))
+        base_at = jnp.where(do_del & j_ok, base_at.at[jc].set(DELETION), base_at)
+
+        # 3. tmp-level choices (valid when not deleting)
+        choice = jnp.where(mode == MODE_E, jnp.int32(_EGAP), (d & 3).astype(jnp.int32))
+        is_diag = ~do_del & (choice == _DIAG)
+        is_egap = ~do_del & (choice == _EGAP)
+        is_fresh = ~do_del & (choice == _FRESH)
+
+        base_at = jnp.where(is_diag & j_ok & rb_known, base_at.at[jc].set(rb), base_at)
+        ins_cnt = jnp.where(is_egap & j_ok & rb_known, ins_cnt.at[jc].add(1), ins_cnt)
+        ins_base = jnp.where(is_egap & j_ok & rb_known, ins_base.at[jc].set(rb), ins_base)
+
+        e_open = (d & _EOPEN_BIT) != 0
+        diag_stop = is_diag & ((d & _DIAG_STOP_BIT) != 0)
+
+        ni = jnp.where(is_diag | is_egap, i - 1, i)
+        nb = jnp.where(do_del, b - 1, jnp.where(is_egap, b + 1, b))
+        nmode = jnp.where(
+            do_del,
+            MODE_TMP,
+            jnp.where(is_egap & ~e_open, MODE_E, MODE_H),
+        )
+        ndone = is_fresh | diag_stop | (ni < 0) | (nb < 0) | (nb >= W)
+        rstart = jnp.where(is_diag | is_egap, i, rstart)
+        fstart = jnp.where(is_diag | do_del, jrow, fstart)
+        return (ni, nb, nmode, new_pending, ndone, base_at, ins_cnt, ins_base, rstart, fstart)
+
+    init = (
+        i0, b0, MODE_H, jnp.int32(0),
+        (score <= 0) | (i0 < 0),
+        base_at0, ins_cnt0, ins_base0,
+        i0 + 1, jend + 1,
+    )
+    out = jax.lax.while_loop(cond, step, init)
+    span = jnp.stack([out[8], i0 + 1, out[9], jend + 1])  # read/ref start,end
+    return out[5], out[6], out[7], span
+
+
+@functools.partial(jax.jit, static_argnames=("band_width", "out_len"))
+def pileup_columns(
+    subreads: jax.Array,
+    subread_lens: jax.Array,
+    draft: jax.Array,
+    draft_len: jax.Array,
+    diag_offsets: jax.Array,
+    band_width: int = 128,
+    out_len: int | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Align each subread to the draft and emit per-position columns.
+
+    Args:
+      subreads: (S, L) dense codes (canonical orientation); subread_lens: (S,).
+      draft: (Ld,) dense codes; draft_len: scalar.
+      diag_offsets: (S,) band centers (0 for same-molecule subreads).
+      out_len: static output width (defaults to Ld).
+
+    Returns:
+      base_at: (S, out_len) uint8 — 0-3 base, 4 deletion, 5 uncovered;
+      ins_cnt: (S, out_len) int32 — insertion run length after position j;
+      ins_base: (S, out_len) uint8 — first base of that insertion run;
+      spans: (S, 4) int32 — [read_start, read_end, ref_start, ref_end)
+        of each subread's local alignment (ends exclusive), for end-extension
+        voting in the consensus driver.
+    """
+    if out_len is None:
+        out_len = draft.shape[0]
+    scoring = (MATCH, MISMATCH, GAP_OPEN, GAP_EXT)
+
+    def one(read, rlen, doff):
+        best, tdir, fjump = _forward_banded(
+            read, rlen, draft, draft_len, doff, band_width, scoring
+        )
+        return _traceback_one(best, tdir, fjump, read, doff, band_width, out_len)
+
+    return jax.vmap(one)(
+        subreads, subread_lens.astype(jnp.int32), diag_offsets.astype(jnp.int32)
+    )

@@ -1,0 +1,136 @@
+"""Hashed k-mer sketching: candidate selection + strand detection on the MXU.
+
+TPU-native replacement for minimap2's seeding stage
+(/root/reference/ont_tcr_consensus/minimap2_align.py:90-132): instead of
+minimizer hash tables and chaining, every sequence becomes a dense hashed
+k-mer count profile, and read->reference candidate selection is one
+``(reads, D) @ (D, refs)`` matmul followed by ``top_k`` — exactly the shape
+the MXU wants. Strand is decided by scoring both the read and its reverse
+complement against the reference panel (minimap2 does this via canonical
+minimizers; a dense profile cannot canonicalize, so we score both).
+
+The base-level alignment then runs only on the short-list
+(:mod:`.sw_align`), with the band center estimated from the amplicon
+geometry (softclip caps, run_config.json:9-10) — see :func:`diag_offset`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# multiplicative hash constant (Knuth); positions k-mers ~uniformly in buckets
+_HASH_MULT = 2654435761
+
+
+@functools.partial(jax.jit, static_argnames=("k", "dim"))
+def kmer_profile(
+    codes: jax.Array, lengths: jax.Array, k: int = 8, dim: int | None = 4096
+) -> jax.Array:
+    """(B, L) dense codes -> (B, dim) float32 k-mer count profiles.
+
+    Windows containing N or padding contribute nothing. With ``dim`` set, the
+    packed 2-bit k-mer is bucketed via a multiplicative hash (k <= 15 fits
+    int32 packing; uint32 wraparound is fine for hashing). ``dim=None``
+    means exact 4**k buckets with no hashing — the small-k mode the UMI
+    shortlist uses.
+    """
+    B, L = codes.shape
+    c = codes.astype(jnp.int32)
+    valid = (c < 4) & (jnp.arange(L)[None, :] < lengths[:, None])
+    packed = jnp.zeros((B, L - k + 1), dtype=jnp.int32)
+    ok = jnp.ones((B, L - k + 1), dtype=bool)
+    for off in range(k):
+        packed = packed * 4 + c[:, off : L - k + 1 + off]
+        ok = ok & valid[:, off : L - k + 1 + off]
+    if dim is None:
+        dim = 4**k
+        bucket = packed
+    else:
+        bucket = (
+            (packed.astype(jnp.uint32) * jnp.uint32(_HASH_MULT)) % jnp.uint32(dim)
+        ).astype(jnp.int32)
+    bucket = jnp.where(ok, bucket, dim)  # overflow bucket, dropped below
+    one_hot = jax.nn.one_hot(bucket, dim + 1, dtype=jnp.float32)
+    return jnp.sum(one_hot, axis=1)[:, :dim]
+
+
+@functools.partial(jax.jit, static_argnames=("top_k",))
+def top_candidates(q_profiles, t_profiles, top_k: int):
+    """Rank targets by raw profile dot product on the MXU; (Q, top_k) indices."""
+    scores = q_profiles @ t_profiles.T
+    _, idx = jax.lax.top_k(scores, top_k)
+    return idx.astype(jnp.int32)
+
+
+@jax.jit
+def revcomp_batch(codes: jax.Array, lengths: jax.Array) -> jax.Array:
+    """Length-aware reverse complement of a padded dense-code batch."""
+    B, L = codes.shape
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    src = lengths[:, None] - 1 - pos
+    in_seq = src >= 0
+    gathered = jnp.take_along_axis(codes, jnp.clip(src, 0, L - 1).astype(jnp.int32), axis=1)
+    comp = jnp.where(gathered < 4, 3 - gathered.astype(jnp.int32), gathered.astype(jnp.int32))
+    return jnp.where(in_seq, comp, gathered.astype(jnp.int32)).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("top_k", "k", "dim"))
+def candidates_both_strands(
+    read_codes: jax.Array,
+    read_lens: jax.Array,
+    ref_profiles: jax.Array,
+    top_k: int = 4,
+    k: int = 8,
+    dim: int = 4096,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Score reads (both strands) against a reference profile panel.
+
+    Args:
+      read_codes: (B, L) dense codes as read from the instrument.
+      ref_profiles: (R, dim) panel from :func:`kmer_profile` (L2-normalized
+        or raw counts — cosine used either way).
+
+    Returns:
+      (cand_idx, cand_score, is_reverse): (B, top_k) int32 candidate ref
+      indices ranked best-first, (B, top_k) float32 cosine scores, and (B,)
+      bool — True where the reverse-complemented read scores higher (i.e.
+      the read is a '-' strand molecule).
+    """
+    fwd = kmer_profile(read_codes, read_lens, k=k, dim=dim)
+    rev = kmer_profile(revcomp_batch(read_codes, read_lens), read_lens, k=k, dim=dim)
+
+    def norm(x):
+        return x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-6)
+
+    refs_n = norm(ref_profiles)
+    fwd_scores = norm(fwd) @ refs_n.T  # (B, R) on the MXU
+    rev_scores = norm(rev) @ refs_n.T
+    is_reverse = jnp.max(rev_scores, axis=1) > jnp.max(fwd_scores, axis=1)
+    scores = jnp.where(is_reverse[:, None], rev_scores, fwd_scores)
+    best, idx = jax.lax.top_k(scores, top_k)
+    return idx.astype(jnp.int32), best, is_reverse
+
+
+@jax.jit
+def similarity_matrix(profiles_a: jax.Array, profiles_b: jax.Array) -> jax.Array:
+    """Cosine similarity panel-vs-panel — the self-homology prefilter
+    (replaces minimap2 -DP all-vs-all, minimap2_align.py:40-73)."""
+
+    def norm(x):
+        return x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-6)
+
+    return norm(profiles_a) @ norm(profiles_b).T
+
+
+def diag_offset(read_lens, ref_lens):
+    """Band-center estimate for :func:`..ops.sw_align.align_banded`.
+
+    The amplicon bounds softclips to <= ~90 nt per side (config
+    max_softclip_5/3_end), so centering the band on the symmetric overhang
+    ``-(read_len - ref_len) / 2`` keeps the true diagonal within a 256-wide
+    band for any split of the overhang between the two ends.
+    """
+    return -((read_lens - ref_lens) // 2)

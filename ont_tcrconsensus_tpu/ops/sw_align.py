@@ -75,6 +75,11 @@ def _pairmax(a, b):
     return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
 
 
+def _shift_up(x, fill):
+    """x[b] -> x[b+1]: the (i-1, j) predecessor lives one band slot right."""
+    return jnp.concatenate([x[1:], jnp.full((1,), fill, x.dtype)])
+
+
 def _align_one(read, read_len, ref, ref_len, diag_offset, band_width, scoring):
     match, mismatch, gap_open, gap_ext = scoring
     W = band_width
@@ -85,9 +90,7 @@ def _align_one(read, read_len, ref, ref_len, diag_offset, band_width, scoring):
     ref_len = ref_len.astype(jnp.int32)
     off = diag_offset.astype(jnp.int32)
 
-    def shift_up(x, fill):
-        """x[b] -> x[b+1] (predecessor (i-1, j) lives one band slot right)."""
-        return jnp.concatenate([x[1:], jnp.full((1,), fill, x.dtype)])
+    shift_up = _shift_up
 
     # channel layout: 0=n_match, 1=n_cols, 2=read_start, 3=ref_start.
     # A fresh (empty) alignment at band cell (i, jrow) has consumed

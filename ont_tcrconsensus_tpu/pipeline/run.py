@@ -1,0 +1,277 @@
+"""The end-to-end two-round UMI consensus pipeline.
+
+Orchestrates the stage functions (:mod:`.stages`) per barcode library,
+mirroring the reference flow (/root/reference/ont_tcr_consensus/
+tcr_consensus.py:33-478):
+
+  PHASE A (once):  reference self-homology -> region clusters + precision bar
+  PHASE B (per library): EE filter -> align + split by region cluster
+  round 1:         UMI extract -> cluster @0.93 -> subread select -> consensus
+  round 2:         consensus align + blast-id filter -> split by region ->
+                   UMI extract -> cluster @0.97 -> select(min=1) -> counts CSV
+
+Unlike the reference (which refuses an existing output dir,
+tcr_consensus.py:84-86), stages record completion in a per-library manifest
+and ``resume=True`` skips completed libraries.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import shutil
+import sys
+
+import numpy as np
+
+from ont_tcrconsensus_tpu.cluster import regions as regions_mod
+from ont_tcrconsensus_tpu.io import fastx, layout
+from ont_tcrconsensus_tpu.pipeline import stages
+from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+
+# fallback precision bar when no reference pair survives the homology filter
+# (the reference would crash there; see cluster/regions.py docstring)
+DEFAULT_BLAST_ID_BAR = 0.99
+
+
+def _log(*parts):
+    print(*parts, file=sys.stderr)
+
+
+def run_pipeline(config_path: str, polisher=None) -> dict[str, dict[str, int]]:
+    """Run the full pipeline; returns {library: {region: count}}."""
+    cfg = RunConfig.from_json(config_path)
+    return run_with_config(cfg, polisher=polisher)
+
+
+def run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]:
+    reference = fastx.read_fasta_dict(cfg.reference_file)
+    nano_dir = os.path.join(cfg.fastq_pass_dir, "nano_tcr")
+    if os.path.exists(nano_dir) and not cfg.resume:
+        raise FileExistsError(
+            f"{nano_dir} exists; set resume=true to continue or remove it"
+        )
+    os.makedirs(nano_dir, exist_ok=True)
+
+    # PHASE A: reference self-homology (tcr_consensus.py:90-105)
+    _log("Mapping reference self homology")
+    homology = regions_mod.self_homology_map(reference, cfg.cluster_identity)
+    with open(os.path.join(nano_dir, "region_cluster_dict.json"), "w") as fh:
+        json.dump(homology.region_cluster, fh, indent=4)
+    with open(os.path.join(nano_dir, "self_homology_stats.json"), "w") as fh:
+        json.dump(homology.stats, fh, indent=4)
+
+    blast_id_threshold = cfg.blast_id_threshold
+    overlap_consensus = cfg.minimal_region_overlap_consensus
+    if blast_id_threshold is None:
+        blast_id_threshold = (
+            homology.max_blast_id if homology.max_blast_id is not None
+            else DEFAULT_BLAST_ID_BAR
+        )
+    if overlap_consensus is None:
+        overlap_consensus = (
+            homology.max_blast_id if homology.max_blast_id is not None
+            else cfg.minimal_region_overlap
+        )
+    if cfg.only_run_reference_self_homology:
+        return {}
+
+    panel = stages.ReferencePanel.build(reference, homology.region_cluster)
+    fastq_list = sorted(glob.glob(os.path.join(cfg.fastq_pass_dir, "barcode*", "*fastq*")))
+    if not fastq_list:
+        fastq_list = sorted(
+            p for p in glob.glob(os.path.join(cfg.fastq_pass_dir, "*.fastq*"))
+        )
+    if not fastq_list:
+        raise FileNotFoundError(f"no fastq files under {cfg.fastq_pass_dir}")
+
+    results: dict[str, dict[str, int]] = {}
+    for fastq in fastq_list:
+        lay = layout.init_library_dir(fastq, nano_dir, resume=cfg.resume)
+        if cfg.resume and lay.stage_done("counts"):
+            _log("Library already complete:", lay.library)
+            counts_csv = os.path.join(lay.counts, "umi_consensus_counts.csv")
+            results[lay.library] = _read_counts_csv(counts_csv)
+            continue
+        results[lay.library] = _run_library(
+            fastq, lay, cfg, panel, blast_id_threshold, overlap_consensus, polisher
+        )
+    _log("Done running all barcodes!")
+    return results
+
+
+def _run_library(fastq, lay, cfg, panel, blast_id_threshold, overlap_consensus,
+                 polisher) -> dict[str, int]:
+    library = lay.library
+    merged_path = os.path.join(lay.fasta, "merged_consensus.fasta")
+
+    # stage-level resume: a completed round 1 is reloaded from its artifact
+    if cfg.resume and lay.stage_done("round1_consensus") and os.path.exists(merged_path):
+        _log("Resuming from round-1 consensus:", library)
+        merged_consensus = [
+            (rec.header, rec.sequence) for rec in fastx.read_fastx(merged_path)
+        ]
+        return _run_round2(lay, cfg, panel, blast_id_threshold, overlap_consensus,
+                           merged_consensus)
+
+    # PHASE B: EE filter (preprocessing.py:104-159)
+    _log("Preprocessing with expected-error filtering:", library)
+    filtered = list(stages.ee_filter_stage(
+        fastx.read_fastx(fastq),
+        max_ee_rate=cfg.max_ee_rate_base,
+        min_len=cfg.minimal_length,
+        batch_size=cfg.read_batch_size,
+        max_read_length=cfg.max_read_length,
+        subsample=cfg.dorado_trim_subsample_fastq,
+    ))
+    with open(os.path.join(lay.logs, "ee_filter.log"), "w") as fh:
+        fh.write(f"reads passing EE/length filter: {len(filtered)}\n")
+
+    # align + split by region cluster (round 1)
+    _log("Aligning nanopore reads:", library)
+    aligned, astats = stages.assign_reads(
+        filtered, panel,
+        minimal_region_overlap=cfg.minimal_region_overlap,
+        max_softclip_5_end=cfg.max_softclip_5_end,
+        max_softclip_3_end=cfg.max_softclip_3_end,
+        batch_size=cfg.read_batch_size,
+        max_read_length=cfg.max_read_length,
+    )
+    _write_align_log(astats, os.path.join(lay.logs, f"{library}_region_cluster_split.log"))
+    groups = stages.split_by_region_cluster(aligned, panel)
+    stages.write_region_fastas(groups, lay.region_cluster_fasta, "region_cluster")
+
+    # round 1: UMI extract / cluster / select / consensus, per region cluster
+    merged_consensus: list[tuple[str, str]] = []
+    for cluster_key in sorted(groups):
+        group_name = f"region_cluster{cluster_key}"
+        reads = [(r.name, r.seq, r.strand) for r in groups[cluster_key]]
+        umis = stages.extract_umis_stage(
+            reads, cfg.umi_fwd, cfg.umi_rev, cfg.max_pattern_dist,
+            cfg.max_softclip_5_end, cfg.max_softclip_3_end,
+        )
+        if not umis:
+            continue
+        stages.write_umi_fasta(
+            umis, os.path.join(lay.umi_fasta, f"{group_name}_detected_umis.fasta")
+        )
+        selected, stat_rows = stages.cluster_and_select(
+            umis,
+            identity=cfg.vsearch_identity,
+            min_umi_length=cfg.min_umi_length,
+            max_umi_length=cfg.max_umi_length,
+            min_reads_per_cluster=cfg.min_reads_per_cluster,
+            max_reads_per_cluster=cfg.max_reads_per_cluster,
+            balance_strands=cfg.balance_strands,
+        )
+        cdir = os.path.join(lay.clustering, group_name)
+        os.makedirs(cdir, exist_ok=True)
+        stages.write_cluster_stats_tsv(
+            stat_rows, os.path.join(cdir, "vsearch_cluster_stats.tsv")
+        )
+        if not selected:
+            continue
+        _log("Polishing clusters:", library, group_name, f"({len(selected)} clusters)")
+        merged_consensus.extend(stages.polish_clusters_stage(
+            selected, group_name,
+            max_read_length=cfg.max_read_length,
+            polisher=polisher,
+        ))
+
+    fastx.write_fasta(merged_path, merged_consensus)
+    lay.mark_stage_done("round1_consensus")
+    return _run_round2(lay, cfg, panel, blast_id_threshold, overlap_consensus,
+                       merged_consensus)
+
+
+def _run_round2(lay, cfg, panel, blast_id_threshold, overlap_consensus,
+                merged_consensus) -> dict[str, int]:
+    library = lay.library
+
+    # round 2: consensus align + blast-id filter + split by exact region
+    _log("Aligning unique molecule consensus TCR sequences:", library)
+    cons_records = [fastx.FastxRecord(h, "", s) for h, s in merged_consensus]
+    cons_aligned, cstats = stages.assign_reads(
+        cons_records, panel,
+        minimal_region_overlap=overlap_consensus,
+        max_softclip_5_end=cfg.max_softclip_5_end,
+        max_softclip_3_end=cfg.max_softclip_3_end,
+        batch_size=cfg.read_batch_size,
+        top_k=4,
+        max_read_length=cfg.max_read_length,
+        blast_id_threshold=blast_id_threshold,
+    )
+    _write_align_log(cstats, os.path.join(lay.logs, f"{library}_merged_consensus_bam_filter.log"))
+    region_groups = stages.split_by_region(cons_aligned, panel)
+    stages.write_region_fastas(region_groups, lay.region_fasta, "region_")
+
+    # round 2: UMI extract + dedup clustering at consensus identity
+    region_counts: dict[str, int] = {}
+    for region, reads_in_region in sorted(region_groups.items()):
+        reads = [(r.name, r.seq, r.strand) for r in reads_in_region]
+        umis = stages.extract_umis_stage(
+            reads, cfg.umi_fwd, cfg.umi_rev, cfg.max_pattern_dist,
+            cfg.max_softclip_5_end, cfg.max_softclip_3_end,
+        )
+        if not umis:
+            continue
+        stages.write_umi_fasta(
+            umis, os.path.join(lay.consensus_umi_fasta, f"region_{region}_detected_umis.fasta")
+        )
+        selected, stat_rows = stages.cluster_and_select(
+            umis,
+            identity=cfg.vsearch_identity_consensus,
+            min_umi_length=cfg.min_umi_length,
+            max_umi_length=cfg.max_umi_length,
+            min_reads_per_cluster=1,
+            max_reads_per_cluster=cfg.max_reads_per_cluster,
+            balance_strands=False,
+        )
+        rdir = os.path.join(lay.clustering_consensus, f"region_{region}")
+        os.makedirs(rdir, exist_ok=True)
+        stages.write_cluster_stats_tsv(
+            stat_rows, os.path.join(rdir, "vsearch_cluster_stats.tsv")
+        )
+        # smolecule parity: one entry per written member, named by cluster
+        smolecule = os.path.join(rdir, "smolecule_clusters.fa")
+        entries = [
+            (str(cl.cluster_id), m.seq) for cl in selected for m in cl.members
+        ]
+        fastx.write_fasta(smolecule, entries)
+        # the reference counts smolecule headers (count.py:9-20): the written
+        # members, capped by the selection math — not the cluster count
+        region_counts[region] = len(entries)
+
+    stages.write_counts_csv(region_counts, lay.counts)
+    lay.mark_stage_done("counts")
+
+    if cfg.delete_tmp_files:
+        for d in (lay.region_cluster_fasta, lay.clustering, lay.umi_fasta,
+                  lay.fasta, lay.clustering_consensus, lay.region_fasta,
+                  lay.consensus_umi_fasta):
+            shutil.rmtree(d, ignore_errors=True)
+
+    return region_counts
+
+
+def _write_align_log(stats: stages.AlignStats, path: str) -> None:
+    with open(path, "w") as fh:
+        fh.write(f"Total # primary alignments: {stats.n_aligned}\n")
+        fh.write(f"n_total: {stats.n_total}\n")
+        fh.write(f"n_short: {stats.n_short}\n")
+        fh.write(f"n_long: {stats.n_long}\n")
+        fh.write(f"n_pass: {stats.n_pass}\n")
+
+
+def _read_counts_csv(path: str) -> dict[str, int]:
+    out: dict[str, int] = {}
+    if not os.path.exists(path):
+        return out
+    with open(path) as fh:
+        next(fh, None)
+        for line in fh:
+            region, _, count = line.rstrip("\n").rpartition(",")
+            if region:
+                out[region] = int(count)
+    return out

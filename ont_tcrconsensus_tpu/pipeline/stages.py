@@ -1,0 +1,514 @@
+"""Pipeline stages: device-batched equivalents of the reference's Ray tasks.
+
+Each function is one stage of the 14-stage reference pipeline
+(/root/reference/ont_tcr_consensus/tcr_consensus.py:33-478), operating on
+padded device batches instead of "Ray task -> subprocess -> files". Stage
+contracts (inputs, filters, artifact layouts) mirror the reference; the
+compute underneath is the kernel library (:mod:`..ops`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from collections import defaultdict
+from collections.abc import Iterable, Iterator
+
+import numpy as np
+
+from ont_tcrconsensus_tpu.cluster import umi as umi_mod
+from ont_tcrconsensus_tpu.io import bucketing, fastx
+from ont_tcrconsensus_tpu.ops import consensus as consensus_mod
+from ont_tcrconsensus_tpu.ops import ee_filter, encode, fuzzy_match, sketch, sw_align
+
+# ---------------------------------------------------------------------------
+# reference panel
+
+
+@dataclasses.dataclass
+class ReferencePanel:
+    """Encoded reference regions + sketch profiles, built once per run."""
+
+    names: list[str]
+    seqs: dict[str, str]
+    codes: np.ndarray          # (R, W) uint8
+    lens: np.ndarray           # (R,) int32
+    profiles: np.ndarray       # (R, dim) float32
+    region_cluster: dict[str, int]
+
+    @classmethod
+    def build(cls, reference: dict[str, str], region_cluster: dict[str, int],
+              pad_multiple: int = 128) -> "ReferencePanel":
+        names = list(reference)
+        max_len = max(len(s) for s in reference.values())
+        codes, lens = encode.encode_batch([reference[n] for n in names], pad_to=max_len,
+                                          multiple=pad_multiple)
+        profiles = np.asarray(sketch.kmer_profile(codes, lens))
+        return cls(names=names, seqs=dict(reference), codes=codes, lens=lens,
+                   profiles=profiles, region_cluster=dict(region_cluster))
+
+    def region_len(self, idx: int) -> int:
+        return int(self.lens[idx])
+
+
+# ---------------------------------------------------------------------------
+# stage: expected-error filtering (vsearch --fastq_filter equivalent,
+# preprocessing.py:104-159)
+
+
+def ee_filter_stage(
+    records: Iterable[fastx.FastxRecord],
+    max_ee_rate: float,
+    min_len: int,
+    batch_size: int = 2048,
+    max_read_length: int = 4096,
+    subsample: int | None = None,
+) -> Iterator[fastx.FastxRecord]:
+    """Stream records through the device EE filter; yields survivors.
+
+    ``subsample`` mirrors ``dorado trim --max-reads`` head-subsampling
+    (preprocessing.py:41-57): only the first N records are considered.
+    """
+    taken = 0
+
+    def limited():
+        nonlocal taken
+        for rec in records:
+            if subsample is not None and taken >= subsample:
+                return
+            taken += 1
+            yield rec
+
+    for batch in bucketing.batch_reads(
+        limited(), batch_size=batch_size,
+        widths=tuple(w for w in bucketing.DEFAULT_WIDTHS if w <= max_read_length),
+        min_len=1,
+    ):
+        keep = np.asarray(
+            ee_filter.ee_rate_mask(batch.quals, batch.lengths, max_ee_rate, min_len)
+        ).copy()
+        keep &= batch.valid
+        kept_ids = set(np.where(keep)[0].tolist())
+        for i in sorted(kept_ids):
+            name, _, comment = batch.ids[i].partition(" ")
+            seq = encode.decode_seq(batch.codes[i], int(batch.lengths[i]))
+            qual = "".join(chr(33 + q) for q in batch.quals[i, : batch.lengths[i]])
+            yield fastx.FastxRecord(name, comment, seq, qual)
+
+
+# ---------------------------------------------------------------------------
+# stage: alignment + region assignment (minimap2_ont_align +
+# filter_and_split_reads_by_region_cluster, minimap2_align.py:76-155 +
+# region_split.py:219-333)
+
+
+@dataclasses.dataclass
+class AlignedRead:
+    name: str
+    seq: str               # original orientation, as sequenced
+    strand: str            # '+' or '-'
+    region_idx: int
+    blast_id: float
+    ref_start: int
+    ref_end: int
+    read_start: int        # in aligned (oriented) coordinates
+    read_end: int
+    score: int
+
+
+@dataclasses.dataclass
+class AlignStats:
+    n_total: int = 0
+    n_aligned: int = 0     # primary-mapped equivalents
+    n_short: int = 0
+    n_long: int = 0
+    n_pass: int = 0
+
+
+def assign_reads(
+    records: Iterable[fastx.FastxRecord],
+    panel: ReferencePanel,
+    minimal_region_overlap: float,
+    max_softclip_5_end: int,
+    max_softclip_3_end: int,
+    batch_size: int = 1024,
+    top_k: int = 2,
+    band_width: int = 256,
+    min_score: int = 100,
+    max_read_length: int = 4096,
+    blast_id_threshold: float | None = None,
+) -> tuple[list[AlignedRead], AlignStats]:
+    """Align every read to its best reference region; apply region filters.
+
+    A read's "primary alignment" is the best banded-SW score over the
+    ``top_k`` sketch candidates on the detected strand. Filters mirror
+    region_split.py:261-269 (ref overlap, read-length window) and — when
+    ``blast_id_threshold`` is given (round 2) — minimap2_align.py:209-245.
+    """
+    stats = AlignStats()
+    out: list[AlignedRead] = []
+    widths = tuple(w for w in bucketing.DEFAULT_WIDTHS if w <= max_read_length)
+    for batch in bucketing.batch_reads(
+        records, batch_size=batch_size, widths=widths, with_quals=False, min_len=1
+    ):
+        nv = batch.num_valid
+        stats.n_total += nv
+        codes = batch.codes[:nv]
+        lens = batch.lengths[:nv]
+        cand_idx, _, is_rev = sketch.candidates_both_strands(
+            codes, lens, panel.profiles, top_k=top_k
+        )
+        cand_idx = np.asarray(cand_idx)
+        is_rev = np.asarray(is_rev)
+        # orient reads for alignment
+        oriented = np.asarray(sketch.revcomp_batch(codes, lens))
+        oriented = np.where(is_rev[:, None], oriented, codes)
+        # align against each candidate; keep the best score
+        best = None
+        for c in range(top_k):
+            ridx = cand_idx[:, c]
+            offs = sketch.diag_offset(lens, panel.lens[ridx]).astype(np.int32)
+            res = sw_align.align_banded(
+                oriented, lens, panel.codes[ridx], panel.lens[ridx], offs,
+                band_width=band_width,
+            )
+            res_np = {
+                "score": np.asarray(res.score), "ridx": ridx,
+                "ref_start": np.asarray(res.ref_start), "ref_end": np.asarray(res.ref_end),
+                "read_start": np.asarray(res.read_start), "read_end": np.asarray(res.read_end),
+                "blast_id": np.asarray(res.blast_id),
+            }
+            if best is None:
+                best = res_np
+            else:
+                better = res_np["score"] > best["score"]
+                for k in best:
+                    best[k] = np.where(better, res_np[k], best[k])
+        for i in range(nv):
+            if best["score"][i] < min_score:
+                continue
+            stats.n_aligned += 1
+            ridx = int(best["ridx"][i])
+            rlen = panel.region_len(ridx)
+            ref_span = int(best["ref_end"][i]) - int(best["ref_start"][i])
+            if ref_span < rlen * minimal_region_overlap:
+                stats.n_short += 1
+                continue
+            if int(lens[i]) > rlen * (2 - minimal_region_overlap) + (
+                max_softclip_5_end + max_softclip_3_end
+            ):
+                stats.n_long += 1
+                continue
+            if blast_id_threshold is not None and not (
+                float(best["blast_id"][i]) > blast_id_threshold
+            ):
+                continue
+            stats.n_pass += 1
+            name, _, _ = batch.ids[i].partition(" ")
+            out.append(AlignedRead(
+                name=name,
+                seq=encode.decode_seq(codes[i], int(lens[i])),
+                strand="-" if is_rev[i] else "+",
+                region_idx=ridx,
+                blast_id=float(best["blast_id"][i]),
+                ref_start=int(best["ref_start"][i]),
+                ref_end=int(best["ref_end"][i]),
+                read_start=int(best["read_start"][i]),
+                read_end=int(best["read_end"][i]),
+                score=int(best["score"][i]),
+            ))
+    return out, stats
+
+
+def split_by_region_cluster(
+    aligned: list[AlignedRead], panel: ReferencePanel
+) -> dict[int, list[AlignedRead]]:
+    """Round-1 grouping: reads binned per region *cluster*
+    (region_split.py:271-280)."""
+    groups: dict[int, list[AlignedRead]] = defaultdict(list)
+    for r in aligned:
+        cluster = panel.region_cluster[panel.names[r.region_idx]]
+        groups[cluster].append(r)
+    return dict(groups)
+
+
+def split_by_region(
+    aligned: list[AlignedRead], panel: ReferencePanel
+) -> dict[str, list[AlignedRead]]:
+    """Round-2 grouping: per exact region (region_split.py:336-435)."""
+    groups: dict[str, list[AlignedRead]] = defaultdict(list)
+    for r in aligned:
+        groups[panel.names[r.region_idx]].append(r)
+    return dict(groups)
+
+
+def write_region_fastas(
+    groups: dict, out_dir: str, prefix: str
+) -> dict[str, str]:
+    """Write per-group fastas in the reference's format: original-orientation
+    sequence, header ``<name>;strand=<+/->`` (region_split.py:273-280)."""
+    paths = {}
+    for key, reads in sorted(groups.items(), key=lambda kv: str(kv[0])):
+        fname = f"{prefix}{key}.fasta"
+        path = os.path.join(out_dir, fname)
+        fastx.write_fasta(
+            path, ((f"{r.name};strand={r.strand}", r.seq) for r in reads)
+        )
+        paths[str(key)] = path
+    return paths
+
+
+# ---------------------------------------------------------------------------
+# stage: UMI extraction (extract_umis.py:189-267)
+
+
+@dataclasses.dataclass
+class UmiRecord:
+    name: str
+    strand: str
+    umi_fwd_dist: int
+    umi_rev_dist: int
+    umi_fwd_seq: str
+    umi_rev_seq: str
+    combined: str          # canonical (molecule) orientation
+    seq: str               # full read, original orientation
+
+    def header(self) -> str:
+        """7-field header parity (extract_umis.py:174-181)."""
+        return (
+            f"{self.name};strand={self.strand};umi_fwd_dist={self.umi_fwd_dist};"
+            f"umi_rev_dist={self.umi_rev_dist};umi_fwd_seq={self.umi_fwd_seq};"
+            f"umi_rev_seq={self.umi_rev_seq};seq={self.seq}"
+        )
+
+
+def extract_umis_stage(
+    reads: list[tuple[str, str, str]],
+    umi_fwd: str,
+    umi_rev: str,
+    max_pattern_dist: int,
+    adapter_length_5_end: int,
+    adapter_length_3_end: int,
+    batch_size: int = 4096,
+) -> list[UmiRecord]:
+    """Find both degenerate UMIs in each read's adapter windows.
+
+    Args:
+      reads: (name, seq_original_orientation, strand) triples.
+
+    The 5' window is searched with ``umi_fwd`` and the 3' window with
+    ``umi_rev`` regardless of strand — the two patterns are reverse
+    complements of each other, so '-' reads match symmetrically
+    (extract_umis.py:221-245). The combined UMI is canonicalized:
+    '+' -> fwd+rev, '-' -> revcomp(rev)+revcomp(fwd)
+    (combine_umis_fasta, extract_umis.py:140-151).
+    """
+    fwd_mask = encode.encode_mask(umi_fwd)
+    rev_mask = encode.encode_mask(umi_rev)
+    out: list[UmiRecord] = []
+    win_pad = max(adapter_length_5_end, adapter_length_3_end)
+
+    for start in range(0, len(reads), batch_size):
+        chunk = reads[start : start + batch_size]
+        win5 = [seq[:adapter_length_5_end] for _, seq, _ in chunk]
+        win3 = [seq[-adapter_length_3_end:] for _, seq, _ in chunk]
+        # pad the final chunk to the full batch size (static shapes)
+        n_pad = batch_size - len(chunk)
+        if n_pad:
+            win5 += [""] * n_pad
+            win3 += [""] * n_pad
+        w5, l5 = encode.encode_mask_batch(win5, pad_to=win_pad)
+        w3, l3 = encode.encode_mask_batch(win3, pad_to=win_pad)
+        d5, s5, e5 = (np.asarray(x) for x in fuzzy_match.fuzzy_find(fwd_mask, w5, l5))
+        d3, s3, e3 = (np.asarray(x) for x in fuzzy_match.fuzzy_find(rev_mask, w3, l3))
+        for i, (name, seq, strand) in enumerate(chunk):
+            if d5[i] > max_pattern_dist or d3[i] > max_pattern_dist:
+                continue
+            u5 = win5[i][s5[i] : e5[i]]
+            u3 = win3[i][s3[i] : e3[i]]
+            if not u5 or not u3:
+                continue
+            if strand == "+":
+                combined = u5 + u3
+            else:
+                combined = encode.revcomp_str(u3) + encode.revcomp_str(u5)
+            out.append(UmiRecord(
+                name=name, strand=strand,
+                umi_fwd_dist=int(d5[i]), umi_rev_dist=int(d3[i]),
+                umi_fwd_seq=u5, umi_rev_seq=u3,
+                combined=combined, seq=seq,
+            ))
+    return out
+
+
+def write_umi_fasta(records: list[UmiRecord], path: str) -> int:
+    """The 'UMI fasta': combined UMI as sequence, full read smuggled in the
+    header (extract_umis.py:154-186)."""
+    return fastx.write_fasta(path, ((r.header(), r.combined) for r in records))
+
+
+# ---------------------------------------------------------------------------
+# stage: UMI clustering + subread selection (vsearch_umi_cluster.py +
+# parse_umi_clusters.py)
+
+
+@dataclasses.dataclass
+class SelectedCluster:
+    cluster_id: int
+    members: list[UmiRecord]       # the selected subreads (<= max)
+    n_fwd: int
+    n_rev: int
+    written_fwd: int
+    written_rev: int
+    n_found: int
+
+
+def cluster_and_select(
+    umi_records: list[UmiRecord],
+    identity: float,
+    min_umi_length: int,
+    max_umi_length: int,
+    min_reads_per_cluster: int,
+    max_reads_per_cluster: int,
+    balance_strands: bool,
+) -> tuple[list[SelectedCluster], list[dict]]:
+    """Cluster combined UMIs, then select subreads per cluster.
+
+    Length bounds replicate vsearch --minseqlength/--maxseqlength (records
+    outside are dropped before clustering, vsearch_umi_cluster.py:29-33).
+    Selection replicates polish_cluster's strand math exactly
+    (parse_umi_clusters.py:67-116): first-come member order, minority strand
+    capped at max/2, optional balancing.
+
+    Returns (selected clusters, per-cluster stats rows — including skipped
+    clusters, for the stats TSV parity).
+    """
+    eligible = [r for r in umi_records if min_umi_length <= len(r.combined) <= max_umi_length]
+    if not eligible:
+        return [], []
+    clusters = umi_mod.cluster_umis([r.combined for r in eligible], identity)
+    members: dict[int, list[UmiRecord]] = defaultdict(list)
+    for rec, lab in zip(eligible, clusters.labels):
+        members[int(lab)].append(rec)
+
+    selected: list[SelectedCluster] = []
+    stat_rows: list[dict] = []
+    for cid in sorted(members):
+        mem = members[cid]
+        fwd = [m for m in mem if m.strand == "+"]
+        rev = [m for m in mem if m.strand == "-"]
+        n_fwd, n_rev = len(fwd), len(rev)
+        if balance_strands:
+            min_fwd = min_rev = min_reads_per_cluster // 2
+            max_after = min(n_fwd * 2, n_rev * 2, max_reads_per_cluster)
+            max_fwd = max_rev = max_after // 2
+        else:
+            min_fwd = min_rev = 0
+            if n_fwd > n_rev:
+                max_rev = min(n_rev, max_reads_per_cluster // 2)
+                max_fwd = min(max_reads_per_cluster - max_rev, n_fwd)
+            else:
+                max_fwd = min(n_fwd, max_reads_per_cluster // 2)
+                max_rev = min(max_reads_per_cluster - max_fwd, n_rev)
+        n_reads = max_fwd + max_rev
+        take = (
+            n_fwd >= min_fwd and n_rev >= min_rev and n_reads >= min_reads_per_cluster
+        )
+        chosen = (fwd[:max_fwd] + rev[:max_rev])[:max_reads_per_cluster] if take else []
+        row = {
+            "id_cluster": f"cluster{cid}",
+            "n_fwd": n_fwd, "n_rev": n_rev,
+            "written_fwd": len([m for m in chosen if m.strand == "+"]),
+            "written_rev": len([m for m in chosen if m.strand == "-"]),
+            "n": len(mem), "written": len(chosen),
+            "cluster_written": int(bool(chosen)),
+        }
+        stat_rows.append(row)
+        if chosen:
+            selected.append(SelectedCluster(
+                cluster_id=cid, members=chosen,
+                n_fwd=n_fwd, n_rev=n_rev,
+                written_fwd=row["written_fwd"], written_rev=row["written_rev"],
+                n_found=len(mem),
+            ))
+    return selected, stat_rows
+
+
+def write_cluster_stats_tsv(stat_rows: list[dict], path: str) -> None:
+    """vsearch_cluster_stats.tsv parity (parse_umi_clusters.py:183-195)."""
+    cols = ["id_cluster", "n_fwd", "n_rev", "written_fwd", "written_rev",
+            "n", "written", "cluster_written"]
+    with open(path, "w") as fh:
+        fh.write("\t".join(cols) + "\n")
+        for row in stat_rows:
+            fh.write("\t".join(str(row[c]) for c in cols) + "\n")
+
+
+# ---------------------------------------------------------------------------
+# stage: consensus polishing (medaka smolecule replacement)
+
+
+def polish_clusters_stage(
+    selected: list[SelectedCluster],
+    group_name: str,
+    max_read_length: int = 4096,
+    rounds: int = 4,
+    band_width: int = 128,
+    polisher=None,
+) -> list[tuple[str, str]]:
+    """Consensus per selected cluster; returns (header, sequence) pairs.
+
+    Headers follow the reference's rewrite
+    ``<group>_<clusterN>_<n_subreads>`` (medaka_polish.py:146-180).
+    Subreads enter in canonical (+) orientation — strand is known from
+    alignment, so no internal re-orientation pass is needed.
+    """
+    out: list[tuple[str, str]] = []
+    for cl in selected:
+        seqs = [
+            m.seq if m.strand == "+" else encode.revcomp_str(m.seq)
+            for m in cl.members
+        ]
+        # static-shape discipline: width from the global length buckets (with
+        # one lane-width of growth slack) and subread count padded to a
+        # power-of-two bucket, so XLA compiles one kernel per (S, W) bucket
+        # instead of one per cluster. Padding rows have length 0: the pileup
+        # kernel scores them 0 and they cast no votes.
+        need = max(len(s) for s in seqs) + 128
+        width = min(
+            max_read_length,
+            next((w for w in bucketing.DEFAULT_WIDTHS if w >= need), max_read_length),
+        )
+        codes, lens = encode.encode_batch(seqs, pad_to=width, multiple=128)
+        s_bucket = 1
+        while s_bucket < len(seqs):
+            s_bucket *= 2
+        if s_bucket > len(seqs):
+            pad_rows = s_bucket - len(seqs)
+            codes = np.concatenate(
+                [codes, np.full((pad_rows, codes.shape[1]), encode.PAD_CODE, np.uint8)]
+            )
+            lens = np.concatenate([lens, np.zeros(pad_rows, lens.dtype)])
+        cons, clen = consensus_mod.consensus_cluster(
+            codes, lens, rounds=rounds, band_width=band_width, pad_to=codes.shape[1]
+        )
+        if polisher is not None:
+            cons, clen = polisher(codes, lens, cons, clen)
+        seq = encode.decode_seq(cons, clen)
+        out.append((f"{group_name}_cluster{cl.cluster_id}_{len(cl.members)}", seq))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# stage: counting (count.py)
+
+
+def write_counts_csv(region_counts: dict[str, int], counts_dir: str,
+                     region_name: str = "TCR") -> str:
+    """counts/umi_consensus_counts.csv parity (count.py:39-51)."""
+    path = os.path.join(counts_dir, "umi_consensus_counts.csv")
+    with open(path, "w") as fh:
+        fh.write(f"{region_name},Count\n")
+        for region, count in region_counts.items():
+            fh.write(f"{region},{count}\n")
+    return path

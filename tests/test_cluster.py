@@ -1,0 +1,104 @@
+"""Region self-homology clustering and greedy UMI clustering."""
+
+import numpy as np
+
+from ont_tcrconsensus_tpu.cluster import regions, umi
+from ont_tcrconsensus_tpu.io import simulator
+
+
+def test_greedy_clustering_replicates_reference_semantics():
+    tuples = [
+        ("a", "b", 0.99),
+        ("c", "d", 0.985),
+        ("b", "c", 0.97),   # joins first cluster containing a/b
+        ("e", "f", 0.5),    # below threshold, both unseen: skipped
+    ]
+    out = regions.greedy_most_similar_clustering(tuples, 0.96)
+    assert out == [{"a", "b", "c"}, {"c", "d"}]  # reference quirk: c in both
+
+
+def test_self_homology_groups_near_duplicates():
+    rng = np.random.default_rng(2)
+    ref = simulator.make_reference(
+        rng, num_regions=6, num_similar_pairs=2, similar_divergence=0.005,
+        num_negative_controls=1,
+    )
+    res = regions.self_homology_map(ref, cluster_threshold=0.93)
+    # each _sim region must share a cluster with its source
+    for name in ref:
+        if "_sim" in name:
+            src = name.split("_sim")[0]
+            assert res.region_cluster[name] == res.region_cluster[src], name
+    # unrelated regions get distinct clusters
+    base = [n for n in ref if "_sim" not in n]
+    assert len({res.region_cluster[n] for n in base}) == len(base)
+    # precision bar reflects the near-duplicate similarity
+    assert res.max_blast_id is not None and res.max_blast_id > 0.98
+    # every region present
+    assert set(res.region_cluster) == set(ref)
+
+
+def test_self_homology_no_similar_pairs():
+    rng = np.random.default_rng(3)
+    ref = simulator.make_reference(rng, num_regions=5)
+    res = regions.self_homology_map(ref, cluster_threshold=0.93)
+    assert res.max_blast_id is None
+    assert len({res.region_cluster[n] for n in ref}) == len(ref)
+
+
+def _mutate_umi(rng, u, n_edits):
+    s = list(u)
+    for _ in range(n_edits):
+        op = rng.integers(3)
+        p = int(rng.integers(len(s)))
+        if op == 0:
+            s[p] = "ACGT"[rng.integers(4)]
+        elif op == 1:
+            s.insert(p, "ACGT"[rng.integers(4)])
+        elif len(s) > 1:
+            del s[p]
+    return "".join(s)
+
+
+def test_umi_clustering_groups_molecules():
+    rng = np.random.default_rng(4)
+    true_umis = [
+        simulator.instantiate_iupac(rng, "TTTVVTTVVVVTTVVVVTTVVVVTTVVVVTTT")
+        + simulator.instantiate_iupac(rng, "AAABBBBAABBBBAABBBBAABBBBAABBAAA")
+        for _ in range(20)
+    ]
+    observed, truth = [], []
+    for mi, u in enumerate(true_umis):
+        for _ in range(int(rng.integers(3, 9))):
+            observed.append(_mutate_umi(rng, u, int(rng.integers(0, 3))))
+            truth.append(mi)
+    out = umi.cluster_umis(observed, identity_threshold=0.93)
+    # clusters must match ground-truth molecule partition exactly:
+    # same molecule -> same cluster, different molecule -> different cluster
+    label_of_mol = {}
+    for lab, mol in zip(out.labels, truth):
+        label_of_mol.setdefault(mol, set()).add(int(lab))
+    for mol, labs in label_of_mol.items():
+        assert len(labs) == 1, f"molecule {mol} split into {labs}"
+    all_labels = [next(iter(l)) for l in label_of_mol.values()]
+    assert len(set(all_labels)) == len(true_umis), "distinct molecules merged"
+    assert out.num_clusters == len(true_umis)
+
+
+def test_umi_clustering_deterministic_and_centroids_valid():
+    rng = np.random.default_rng(5)
+    base = simulator.instantiate_iupac(rng, "TTTVVTTVVVVTTVVVVTTVVVVTTVVVVTTT")
+    umis = [base, _mutate_umi(rng, base, 1), base, _mutate_umi(rng, base, 2)]
+    a = umi.cluster_umis(umis, identity_threshold=0.9)
+    b = umi.cluster_umis(list(umis), identity_threshold=0.9)
+    np.testing.assert_array_equal(a.labels, b.labels)
+    assert a.num_clusters == 1
+    # centroid index points at a member of the cluster
+    assert a.labels[a.centroid_of[0]] == 0
+
+
+def test_umi_clustering_empty_and_single():
+    out = umi.cluster_umis([], identity_threshold=0.9)
+    assert out.num_clusters == 0
+    out1 = umi.cluster_umis(["ACGTACGT"], identity_threshold=0.9)
+    assert out1.num_clusters == 1 and list(out1.labels) == [0]

@@ -1,0 +1,143 @@
+"""Pileup traceback kernel and iterative vote consensus."""
+
+import numpy as np
+
+from ont_tcrconsensus_tpu.io import simulator
+from ont_tcrconsensus_tpu.ops import consensus, encode, pileup
+
+
+def _pad(seq_codes, width):
+    out = np.full((width,), encode.PAD_CODE, np.uint8)
+    out[: len(seq_codes)] = seq_codes
+    return out
+
+
+def _pile_one(read_str, draft_str, width=128, band=64):
+    read = encode.encode_seq(read_str)
+    draft = encode.encode_seq(draft_str)
+    base_at, ins_cnt, ins_base, spans = pileup.pileup_columns(
+        _pad(read, width)[None, :],
+        np.array([len(read)], np.int32),
+        _pad(draft, width),
+        np.int32(len(draft)),
+        np.zeros(1, np.int32),
+        band_width=band,
+        out_len=width,
+    )
+    return np.asarray(base_at)[0], np.asarray(ins_cnt)[0], np.asarray(ins_base)[0]
+
+
+def test_pileup_exact_read():
+    draft = "ACGTACGTAGGTTCACACGGTT"
+    base_at, ins_cnt, _ = _pile_one(draft, draft)
+    want = encode.encode_seq(draft)
+    np.testing.assert_array_equal(base_at[: len(draft)], want)
+    assert (base_at[len(draft) :] == pileup.UNCOVERED).all()
+    assert (ins_cnt == 0).all()
+
+
+def test_pileup_substitution():
+    draft = "ACGTACGTAGGTTCACACGGTT"
+    read = draft[:5] + "T" + draft[6:]  # A->T at position 5 (draft has C at 5)
+    assert draft[5] != "T"
+    base_at, _, _ = _pile_one(read, draft)
+    want = encode.encode_seq(draft)
+    got = base_at[: len(draft)]
+    diffs = np.where(got != want)[0]
+    np.testing.assert_array_equal(diffs, [5])
+    assert got[5] == encode.encode_seq("T")[0]
+
+
+def test_pileup_deletion():
+    draft = "ACGTACGTAGGTTCACACGGTT"
+    read = draft[:8] + draft[9:]  # draft position 8 deleted
+    base_at, _, _ = _pile_one(read, draft)
+    assert base_at[8] == pileup.DELETION
+    want = encode.encode_seq(draft)
+    got = base_at[: len(draft)]
+    assert (got[np.arange(len(draft)) != 8] == want[np.arange(len(draft)) != 8]).all()
+
+
+def test_pileup_insertion():
+    draft = "ACGTACGTAGGTTCACACGGTT"
+    # inserted base differs from both neighbours (draft[8]='A', draft[9]='G')
+    # so the optimal alignment is unambiguous
+    read = draft[:9] + "C" + draft[9:]  # insertion after draft position 8
+    base_at, ins_cnt, ins_base = _pile_one(read, draft)
+    np.testing.assert_array_equal(base_at[: len(draft)], encode.encode_seq(draft))
+    hits = np.where(ins_cnt > 0)[0]
+    np.testing.assert_array_equal(hits, [8])
+    assert ins_base[8] == encode.encode_seq("C")[0]
+    assert ins_cnt[8] == 1
+
+
+def test_pileup_partial_coverage():
+    draft = "ACGTACGTAGGTTCACACGGTT"
+    read = draft[6:17]  # interior slice only
+    base_at, _, _ = _pile_one(read, draft)
+    got = base_at[: len(draft)]
+    assert (got[:6] == pileup.UNCOVERED).all()
+    assert (got[17:] == pileup.UNCOVERED).all()
+    np.testing.assert_array_equal(got[6:17], encode.encode_seq(draft)[6:17])
+
+
+def _noisy_reads(rng, template, n, sub, ins, dele):
+    reads = []
+    for _ in range(n):
+        s, _ = simulator.mutate(rng, template, sub, ins, dele)
+        reads.append(encode.encode_seq(s))
+    return reads
+
+
+def test_consensus_recovers_template():
+    rng = np.random.default_rng(0)
+    template = simulator._rand_seq(rng, 300)
+    reads = _noisy_reads(rng, template, 12, 0.02, 0.01, 0.01)
+    width = 512
+    sub = np.stack([_pad(r, width) for r in reads])
+    lens = np.array([len(r) for r in reads], np.int32)
+    cons, clen = consensus.consensus_cluster(sub, lens, rounds=3, band_width=128, pad_to=width)
+    got = encode.decode_seq(cons, clen)
+    assert got == template
+
+
+def test_consensus_full_amplicon():
+    rng = np.random.default_rng(1)
+    region = simulator._rand_seq(rng, 1500)
+    umi_f = simulator.instantiate_iupac(rng, "TTTVVTTVVVVTTVVVVTTVVVVTTVVVVTTT")
+    umi_r = simulator.instantiate_iupac(rng, "AAABBBBAABBBBAABBBBAABBBBAABBAAA")
+    template = simulator.LEFT_FLANK + umi_f + region + umi_r + simulator.RIGHT_FLANK
+    reads = _noisy_reads(rng, template, 8, 0.02, 0.01, 0.01)
+    width = 2048
+    sub = np.stack([_pad(r, width) for r in reads])
+    lens = np.array([len(r) for r in reads], np.int32)
+    cons, clen = consensus.consensus_cluster(sub, lens, rounds=3, band_width=128, pad_to=width)
+    got = encode.decode_seq(cons, clen)
+    assert got == template, f"consensus differs: len {len(got)} vs {len(template)}"
+
+
+def test_consensus_low_depth_still_close():
+    rng = np.random.default_rng(2)
+    template = simulator._rand_seq(rng, 300)
+    reads = _noisy_reads(rng, template, 4, 0.02, 0.01, 0.01)
+    width = 512
+    sub = np.stack([_pad(r, width) for r in reads])
+    lens = np.array([len(r) for r in reads], np.int32)
+    cons, clen = consensus.consensus_cluster(sub, lens, rounds=3, band_width=128, pad_to=width)
+    got = encode.decode_seq(cons, clen)
+    # at depth 4 a few residual errors are expected; identity must be high
+    from ont_tcrconsensus_tpu.ops import sw_align
+
+    res = sw_align.align_np(encode.encode_seq(got), encode.encode_seq(template))
+    assert res.n_match / max(len(template), 1) > 0.99
+
+
+def test_pileup_features_shape():
+    draft = "ACGTACGTAGGTTCACACGGTT"
+    base_at, ins_cnt, ins_base = _pile_one(draft, draft, width=128)
+    feats = consensus.pileup_features(
+        np.asarray(base_at)[None, :], np.asarray(ins_cnt)[None, :],
+        _pad(encode.encode_seq(draft), 128),
+    )
+    assert feats.shape == (128, 11)
+    assert bool(np.isfinite(np.asarray(feats)).all())

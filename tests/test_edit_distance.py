@@ -1,6 +1,6 @@
 import numpy as np
 
-from ont_tcrconsensus_tpu.ops import edit_distance, encode
+from ont_tcrconsensus_tpu.ops import edit_distance, encode, sketch
 
 
 def _lev(a, b):
@@ -76,9 +76,9 @@ def test_kmer_prefilter_ranks_true_match_first():
         queries.append("".join(t))
     qb, ql = encode.encode_batch(queries)
     tb, tl = encode.encode_batch(targets)
-    qp = edit_distance.kmer_profile(qb, ql)
-    tp = edit_distance.kmer_profile(tb, tl)
-    cand = np.asarray(edit_distance.top_candidates(qp, tp, top_k=4))
+    qp = sketch.kmer_profile(qb, ql, k=4, dim=None)
+    tp = sketch.kmer_profile(tb, tl, k=4, dim=None)
+    cand = np.asarray(sketch.top_candidates(qp, tp, top_k=4))
     for row, i in enumerate(q_idx):
         assert i in cand[row], (row, i, cand[row])
 

@@ -1,0 +1,65 @@
+"""k-mer sketching: candidate selection, strand detection, revcomp."""
+
+import numpy as np
+
+from ont_tcrconsensus_tpu.io import simulator
+from ont_tcrconsensus_tpu.ops import encode, sketch
+
+
+def _encode_batch(seqs, pad_to):
+    return encode.encode_batch(seqs, pad_to=pad_to)
+
+
+def test_revcomp_batch_matches_host():
+    rng = np.random.default_rng(0)
+    seqs = ["".join(rng.choice(list("ACGT"), size=int(rng.integers(20, 100)))) for _ in range(8)]
+    codes, lens = _encode_batch(seqs, 128)
+    rc = np.asarray(sketch.revcomp_batch(codes, lens))
+    for i, s in enumerate(seqs):
+        want = encode.encode_seq(simulator.revcomp(s))
+        np.testing.assert_array_equal(rc[i, : len(s)], want)
+
+
+def test_candidates_find_true_region_and_strand():
+    lib = simulator.simulate_library(seed=5, num_regions=6)
+    ref_names = list(lib.reference)
+    ref_codes, ref_lens = _encode_batch([lib.reference[n] for n in ref_names], 4096)
+    profiles = sketch.kmer_profile(ref_codes, ref_lens)
+
+    reads = [r for r in lib.reads[:64]]
+    codes, lens = _encode_batch([seq for _, seq, _ in reads], 4096)
+    idx, score, is_rev = sketch.candidates_both_strands(codes, lens, profiles)
+    idx, is_rev = np.asarray(idx), np.asarray(is_rev)
+
+    by_mol = {i: m for i, m in enumerate(lib.molecules)}
+    correct = strand_ok = 0
+    for r, (header, _, _) in enumerate(reads):
+        mol = by_mol[int(header.split("mol=")[1].split()[0])]
+        orient = header.split("orient=")[1].split()[0]
+        if ref_names[idx[r, 0]] == mol.region:
+            correct += 1
+        if (orient == "-") == bool(is_rev[r]):
+            strand_ok += 1
+    assert correct == len(reads), "top-1 candidate must be the true region"
+    assert strand_ok == len(reads), "strand detection must be exact"
+
+
+def test_similar_regions_rank_together():
+    rng = np.random.default_rng(1)
+    ref = simulator.make_reference(rng, num_regions=5, num_similar_pairs=1)
+    names = list(ref)
+    codes, lens = _encode_batch([ref[n] for n in names], 4096)
+    profiles = sketch.kmer_profile(codes, lens)
+    sim = np.asarray(sketch.similarity_matrix(profiles, profiles))
+    sim_name = [n for n in names if "_sim" in n][0]
+    src = sim_name.split("_sim")[0]
+    i, j = names.index(src), names.index(sim_name)
+    off = sim[i, j]
+    others = [sim[i, k] for k in range(len(names)) if k not in (i, j)]
+    assert off > 0.5
+    assert off > max(others) + 0.3
+
+
+def test_diag_offset_symmetric():
+    off = sketch.diag_offset(np.array([2100, 2000]), np.array([2000, 2100]))
+    assert list(off) == [-50, 50]
