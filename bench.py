@@ -1,0 +1,92 @@
+"""Benchmark: end-to-end pipeline throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: reads/sec through the complete two-round consensus pipeline
+(EE filter -> align/assign -> UMI extract -> cluster -> subread select ->
+vote consensus (+RNN polish if bundled) -> consensus align/filter -> round-2
+dedup -> counts) on a simulated library, measured on the second run so
+compile time is excluded (caches are warm in-process).
+
+Baseline: the reference CPU pipeline processes ~70M reads in 20-24h on a
+110-CPU Xeon Silver node (BASELINE.md) => ~884 reads/s for the whole node.
+vs_baseline = our single-chip reads/s divided by that node rate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import time
+
+REFERENCE_NODE_READS_PER_SEC = 70e6 / (22 * 3600)  # ~884, BASELINE.md midpoint
+
+
+def build_dataset(root: str, seed: int = 33):
+    from ont_tcrconsensus_tpu.io import fastx, simulator
+
+    lib = simulator.simulate_library(
+        seed=seed,
+        num_regions=8,
+        molecules_per_region=(6, 10),
+        reads_per_molecule=(6, 12),
+        sub_rate=0.01,
+        ins_rate=0.004,
+        del_rate=0.004,
+    )
+    os.makedirs(os.path.join(root, "fastq_pass", "barcode01"), exist_ok=True)
+    fastx.write_fasta(os.path.join(root, "reference.fa"), lib.reference.items())
+    fastx.write_fastq(
+        os.path.join(root, "fastq_pass", "barcode01", "barcode01.fastq.gz"), lib.reads
+    )
+    return lib
+
+
+def run_once(root: str):
+    from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+    from ont_tcrconsensus_tpu.pipeline.run import run_with_config
+
+    shutil.rmtree(os.path.join(root, "fastq_pass", "nano_tcr"), ignore_errors=True)
+    cfg = RunConfig.from_dict({
+        "reference_file": os.path.join(root, "reference.fa"),
+        "fastq_pass_dir": os.path.join(root, "fastq_pass"),
+        "minimal_length": 1000,
+        "min_reads_per_cluster": 4,
+        "read_batch_size": 256,
+        "delete_tmp_files": True,
+    })
+    t0 = time.time()
+    results = run_with_config(cfg)
+    dt = time.time() - t0
+    return results, dt
+
+
+def main():
+    root = "/tmp/ont_tcr_bench"
+    shutil.rmtree(root, ignore_errors=True)
+    lib = build_dataset(root)
+    n_reads = len(lib.reads)
+
+    # warm-up run compiles every kernel; timed run measures steady state
+    _, warm_dt = run_once(root)
+    results, dt = run_once(root)
+
+    counts_ok = results.get("barcode01") == lib.true_counts
+    reads_per_sec = n_reads / dt
+    print(
+        f"bench: {n_reads} reads, warm {warm_dt:.1f}s, timed {dt:.1f}s, "
+        f"counts_exact={counts_ok}",
+        file=sys.stderr,
+    )
+    print(json.dumps({
+        "metric": "pipeline_reads_per_sec_per_chip",
+        "value": round(reads_per_sec, 2),
+        "unit": "reads/s",
+        "vs_baseline": round(reads_per_sec / REFERENCE_NODE_READS_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -29,7 +29,7 @@ import dataclasses
 
 import numpy as np
 
-from ont_tcrconsensus_tpu.ops import encode, sketch, sw_align
+from ont_tcrconsensus_tpu.ops import encode, sketch, sw_pallas
 
 NEGATIVE_CONTROL_SUFFIXES = ("_v_n", "cdr3j_n", "full_n")  # region_split.py:305
 
@@ -103,7 +103,7 @@ def self_homology_map(
         offs = sketch.diag_offset(lens[ii], lens[jj]).astype(np.int32)
         for s in range(0, len(ii), pair_batch):
             sl = slice(s, min(s + pair_batch, len(ii)))
-            res = sw_align.align_banded(
+            res = sw_pallas.align_banded_auto(
                 codes[ii[sl]], lens[ii[sl]], codes[jj[sl]], lens[jj[sl]],
                 offs[sl], band_width=band_width,
             )

@@ -1,0 +1,127 @@
+"""ctypes loader for the native fastx parser (build-on-first-use).
+
+The shared library is compiled from ``fastx_parser.cpp`` with the system
+g++ on first import (cached next to the source); when no compiler/zlib is
+available every consumer silently falls back to the pure-Python parser in
+:mod:`..fastx`, which has identical semantics (the native parser's contract
+is pinned by tests that compare the two).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "fastx_parser.cpp")
+_LIB = os.path.join(os.path.dirname(__file__), "libfastx.so")
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_build_failed = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", _SRC, "-lz", "-o", _LIB]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=240)
+        return proc.returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def load() -> ctypes.CDLL | None:
+    """The shared library, building it if needed; None when unavailable."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            if not _build():
+                _build_failed = True
+                return None
+        lib = ctypes.CDLL(_LIB)
+        lib.fastx_parse.restype = ctypes.c_void_p
+        lib.fastx_parse.argtypes = [ctypes.c_char_p]
+        lib.fastx_error.restype = ctypes.c_char_p
+        lib.fastx_error.argtypes = [ctypes.c_void_p]
+        for fn in ("fastx_num_records", "fastx_total_bases", "fastx_names_size"):
+            getattr(lib, fn).restype = ctypes.c_int64
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        lib.fastx_has_qual.restype = ctypes.c_int
+        lib.fastx_has_qual.argtypes = [ctypes.c_void_p]
+        lib.fastx_copy.restype = None
+        lib.fastx_copy.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_char_p,
+        ]
+        lib.fastx_free.restype = None
+        lib.fastx_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+@dataclasses.dataclass
+class ParsedFastx:
+    """Columnar parse result: dense codes ready for the device batcher."""
+
+    codes: np.ndarray     # (total_bases,) uint8 dense codes
+    quals: np.ndarray | None  # (total_bases,) uint8 phred, None for FASTA
+    lengths: np.ndarray   # (N,) int32
+    offsets: np.ndarray   # (N+1,) int64 into codes/quals
+    names: list[str]      # full headers
+
+    @property
+    def num_records(self) -> int:
+        return len(self.lengths)
+
+    def record(self, i: int) -> tuple[str, np.ndarray, np.ndarray | None]:
+        s, e = self.offsets[i], self.offsets[i + 1]
+        return (
+            self.names[i],
+            self.codes[s:e],
+            self.quals[s:e] if self.quals is not None else None,
+        )
+
+
+def parse_file(path: str | os.PathLike[str]) -> ParsedFastx | None:
+    """Parse with the native library; None when the library is unavailable.
+
+    Raises ValueError on malformed input (same contract as fastx.read_fastx).
+    """
+    lib = load()
+    if lib is None:
+        return None
+    handle = lib.fastx_parse(os.fspath(path).encode())
+    try:
+        err = lib.fastx_error(handle)
+        if err:
+            raise ValueError(f"{path}: {err.decode()}")
+        n = lib.fastx_num_records(handle)
+        total = lib.fastx_total_bases(handle)
+        has_qual = bool(lib.fastx_has_qual(handle))
+        codes = np.zeros(total, np.uint8)
+        quals = np.zeros(total, np.uint8) if has_qual else None
+        lengths = np.zeros(n, np.int32)
+        offsets = np.zeros(n + 1, np.int64)
+        names_buf = ctypes.create_string_buffer(int(lib.fastx_names_size(handle)))
+        lib.fastx_copy(
+            handle,
+            codes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            quals.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)) if has_qual else None,
+            lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            names_buf,
+        )
+        names = names_buf.raw.decode("utf-8", "replace").split("\n")[:n]
+        return ParsedFastx(codes=codes, quals=quals, lengths=lengths,
+                           offsets=offsets, names=names)
+    finally:
+        lib.fastx_free(handle)

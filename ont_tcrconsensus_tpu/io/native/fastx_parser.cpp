@@ -1,0 +1,217 @@
+// Fast FASTQ/FASTA parser: the native host-IO component of the data plane.
+//
+// The reference pipeline leans on pysam/htslib (C) and external tools for
+// sequence IO (SURVEY §2.2); this framework's equivalent is a first-party
+// C++ parser that decodes records straight into the dense uint8 code / Phred
+// arrays the device batcher consumes, skipping Python string round-trips.
+// Loaded via ctypes (io/native/__init__.py); the pure-Python parser in
+// io/fastx.py remains the semantic reference and fallback.
+//
+// Build: g++ -O3 -shared -fPIC fastx_parser.cpp -lz -o libfastx.so
+
+#include <zlib.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct ParsedFile {
+  // flat record storage
+  std::vector<uint8_t> codes;      // dense codes, concatenated
+  std::vector<uint8_t> quals;      // phred (0-based), concatenated; empty for FASTA
+  std::vector<int64_t> offsets;    // per-record start into codes/quals (n+1 entries)
+  std::vector<int32_t> lengths;    // per-record length
+  std::string names;               // '\n'-joined full headers
+  bool has_qual = false;
+  std::string error;
+};
+
+// base -> dense code (A=0 C=1 G=2 T=3 N/other=4), matching ops/encode.py
+const uint8_t* code_lut() {
+  static uint8_t lut[256];
+  static bool init = false;
+  if (!init) {
+    memset(lut, 4, sizeof(lut));
+    lut['A'] = lut['a'] = 0;
+    lut['C'] = lut['c'] = 1;
+    lut['G'] = lut['g'] = 2;
+    lut['T'] = lut['t'] = lut['U'] = lut['u'] = 3;
+    init = true;
+  }
+  return lut;
+}
+
+bool read_all(const char* path, std::string* out, std::string* err) {
+  gzFile fh = gzopen(path, "rb");  // transparently handles plain files too
+  if (!fh) {
+    *err = "cannot open file";
+    return false;
+  }
+  char buf[1 << 16];
+  int n;
+  while ((n = gzread(fh, buf, sizeof(buf))) > 0) out->append(buf, n);
+  bool ok = n == 0;
+  if (!ok) *err = "read/decompress error";
+  gzclose(fh);
+  return ok;
+}
+
+// next line [start, end) exclusive of newline; returns false at EOF
+bool next_line(const std::string& s, size_t* pos, size_t* start, size_t* end) {
+  if (*pos >= s.size()) return false;
+  *start = *pos;
+  size_t nl = s.find('\n', *pos);
+  if (nl == std::string::npos) {
+    *end = s.size();
+    *pos = s.size();
+  } else {
+    *end = nl;
+    *pos = nl + 1;
+  }
+  if (*end > *start && s[*end - 1] == '\r') --*end;
+  return true;
+}
+
+bool parse_buffer(const std::string& data, ParsedFile* out) {
+  const uint8_t* lut = code_lut();
+  size_t pos = 0, a, b;
+  out->offsets.push_back(0);
+  // skip leading blank lines
+  while (next_line(data, &pos, &a, &b)) {
+    if (a == b) continue;
+    break;
+  }
+  if (pos == 0 && a == b) return true;  // empty file
+  char kind = data[a];
+  if (kind != '@' && kind != '>') {
+    out->error = "not FASTA/FASTQ";
+    return false;
+  }
+  out->has_qual = kind == '@';
+  // rewind to the first record line
+  size_t first = a;
+  pos = first;
+  if (kind == '>') {
+    std::string seq;
+    std::string name;
+    bool have = false;
+    while (next_line(data, &pos, &a, &b)) {
+      if (a == b) continue;
+      if (data[a] == '>') {
+        if (have) {
+          for (char c : seq) out->codes.push_back(lut[(uint8_t)c]);
+          out->lengths.push_back((int32_t)seq.size());
+          out->offsets.push_back((int64_t)out->codes.size());
+          out->names += name;
+          out->names += '\n';
+        }
+        name.assign(data, a + 1, b - a - 1);
+        seq.clear();
+        have = true;
+      } else {
+        seq.append(data, a, b - a);
+      }
+    }
+    if (have) {
+      for (char c : seq) out->codes.push_back(lut[(uint8_t)c]);
+      out->lengths.push_back((int32_t)seq.size());
+      out->offsets.push_back((int64_t)out->codes.size());
+      out->names += name;
+      out->names += '\n';
+    }
+    return true;
+  }
+  // FASTQ: strict 4-line records, blank lines tolerated between records
+  while (true) {
+    // header
+    bool got = false;
+    while (next_line(data, &pos, &a, &b)) {
+      if (a == b) continue;
+      got = true;
+      break;
+    }
+    if (!got) break;
+    if (data[a] != '@') {
+      out->error = "malformed FASTQ header";
+      return false;
+    }
+    size_t ha = a + 1, hb = b;
+    size_t sa, sb, pa, pb, qa, qb;
+    if (!next_line(data, &pos, &sa, &sb) || !next_line(data, &pos, &pa, &pb) ||
+        !next_line(data, &pos, &qa, &qb)) {
+      out->error = "truncated FASTQ record";
+      return false;
+    }
+    if (pa == pb || data[pa] != '+') {
+      out->error = "malformed FASTQ record (missing +)";
+      return false;
+    }
+    size_t slen = sb - sa, qlen = qb - qa;
+    if (slen != qlen) {
+      out->error = "FASTQ qual length != seq length";
+      return false;
+    }
+    for (size_t i = sa; i < sb; ++i) out->codes.push_back(lut[(uint8_t)data[i]]);
+    for (size_t i = qa; i < qb; ++i) {
+      uint8_t q = (uint8_t)data[i];
+      if (q < 33) {
+        out->error = "quality below Phred-33 '!'";
+        return false;
+      }
+      out->quals.push_back(q - 33);
+    }
+    out->lengths.push_back((int32_t)slen);
+    out->offsets.push_back((int64_t)out->codes.size());
+    out->names.append(data, ha, hb - ha);
+    out->names += '\n';
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Opaque handle API: parse once, copy out, free.
+void* fastx_parse(const char* path) {
+  auto* out = new ParsedFile();
+  std::string data;
+  if (!read_all(path, &data, &out->error)) return out;
+  if (!parse_buffer(data, out)) {
+    out->codes.clear();
+    out->quals.clear();
+    out->lengths.clear();
+    out->offsets.assign(1, 0);
+    out->names.clear();
+  }
+  return out;
+}
+
+const char* fastx_error(void* h) {
+  auto* p = (ParsedFile*)h;
+  return p->error.empty() ? nullptr : p->error.c_str();
+}
+
+int64_t fastx_num_records(void* h) { return (int64_t)((ParsedFile*)h)->lengths.size(); }
+int64_t fastx_total_bases(void* h) { return (int64_t)((ParsedFile*)h)->codes.size(); }
+int64_t fastx_names_size(void* h) { return (int64_t)((ParsedFile*)h)->names.size(); }
+int fastx_has_qual(void* h) { return ((ParsedFile*)h)->has_qual ? 1 : 0; }
+
+void fastx_copy(void* h, uint8_t* codes, uint8_t* quals, int32_t* lengths,
+                int64_t* offsets, char* names) {
+  auto* p = (ParsedFile*)h;
+  if (!p->codes.empty()) memcpy(codes, p->codes.data(), p->codes.size());
+  if (quals && !p->quals.empty()) memcpy(quals, p->quals.data(), p->quals.size());
+  if (!p->lengths.empty())
+    memcpy(lengths, p->lengths.data(), p->lengths.size() * sizeof(int32_t));
+  memcpy(offsets, p->offsets.data(), p->offsets.size() * sizeof(int64_t));
+  if (!p->names.empty()) memcpy(names, p->names.data(), p->names.size());
+}
+
+void fastx_free(void* h) { delete (ParsedFile*)h; }
+
+}  // extern "C"

@@ -1,0 +1,182 @@
+"""Flax bidirectional-GRU consensus polisher (the medaka-RNN replacement).
+
+The reference's precision stage is medaka's pileup-counts bi-GRU
+(/root/reference/ont_tcr_consensus/medaka_polish.py:113-134, model
+``r1041_e82_400bps_sup_v5.0.0``). medaka's pretrained weights target its own
+feature encoding and basecaller error profile; this framework instead trains
+the same architecture family *in-repo* on the simulator's error model
+(:mod:`..io.simulator`) — documented divergence: weights are not ports, the
+architecture (counts features -> stacked bi-GRU -> per-position class head)
+is the medaka design.
+
+Classes per draft position: 0-3 = A/C/G/T, 4 = deletion (position absent
+from the true sequence). Insertions are handled upstream by the vote stage
+(:mod:`..ops.consensus`); the RNN fixes residual substitution/deletion errors
+that majority voting leaves at low depth.
+
+All shapes static: (batch, length, features) -> (batch, length, 5).
+"""
+
+from __future__ import annotations
+
+import os
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NUM_CLASSES = 5
+FEATURE_DIM = 11  # see ops.consensus.pileup_features
+
+
+class BiGRU(nn.Module):
+    """One bidirectional GRU layer; concatenates both directions."""
+
+    hidden: int
+
+    @nn.compact
+    def __call__(self, x):
+        fwd = nn.RNN(nn.GRUCell(self.hidden), name="fwd")(x)
+        bwd = nn.RNN(nn.GRUCell(self.hidden), reverse=True, keep_order=True, name="bwd")(x)
+        return jnp.concatenate([fwd, bwd], axis=-1)
+
+
+class ConsensusPolisher(nn.Module):
+    """medaka-class polisher: Dense -> 2x bi-GRU -> class head."""
+
+    hidden: int = 96
+    num_layers: int = 2
+
+    @nn.compact
+    def __call__(self, feats):
+        x = nn.Dense(self.hidden, name="embed")(feats)
+        x = nn.gelu(x)
+        for i in range(self.num_layers):
+            x = BiGRU(self.hidden, name=f"bigru{i}")(x)
+        return nn.Dense(NUM_CLASSES, name="head")(x)
+
+
+def init_params(rng_seed: int = 0, length: int = 128) -> dict:
+    model = ConsensusPolisher()
+    rng = jax.random.PRNGKey(rng_seed)
+    return model.init(rng, jnp.zeros((1, length, FEATURE_DIM)))["params"]
+
+
+def apply_logits(params, feats: jax.Array) -> jax.Array:
+    """(B, L, F) -> (B, L, 5) logits."""
+    return ConsensusPolisher().apply({"params": params}, feats)
+
+
+def polish_draft(
+    params, feats: np.ndarray, draft: np.ndarray, draft_len: int,
+    depth: np.ndarray | None = None,
+    min_confidence: float = 0.9,
+) -> tuple[np.ndarray, int]:
+    """Apply the polisher to one draft: predicted subs applied, deletions cut.
+
+    Args:
+      feats: (L, F) pileup features (ops.consensus.pileup_features).
+      draft: (L,) dense codes; draft_len: true length.
+      depth: (L,) pileup depth; positions with no coverage keep the draft
+        base verbatim (the model has no evidence there).
+      min_confidence: the model only overrides the draft where its softmax
+        probability exceeds this — a polisher must never be worse than doing
+        nothing, so low-confidence disagreements defer to the vote consensus
+        (medaka imposes the same property through sheer training scale).
+
+    Returns (polished codes padded to L, new length).
+    """
+    from ont_tcrconsensus_tpu.ops.encode import PAD_CODE
+
+    logits = np.asarray(apply_logits(params, jnp.asarray(feats)[None, :, :]))[0]
+    pred = logits.argmax(axis=-1).astype(np.uint8)  # (L,)
+    probs = np.exp(logits - logits.max(axis=-1, keepdims=True))
+    probs /= probs.sum(axis=-1, keepdims=True)
+    confident = probs.max(axis=-1) >= min_confidence
+    L = draft.shape[0]
+    in_draft = np.arange(L) < int(draft_len)
+    covered = in_draft if depth is None else (in_draft & (np.asarray(depth) > 0))
+    apply = covered & confident
+    base = np.where(apply, pred, draft)
+    keep = in_draft & ~(apply & (pred == 4))
+    kept = base[keep].astype(np.uint8)
+    out = np.full((L,), PAD_CODE, np.uint8)
+    out[: kept.size] = kept
+    return out, int(kept.size)
+
+
+def make_pipeline_polisher(params, band_width: int = 128):
+    """Adapter for ``stages.polish_clusters_stage(polisher=...)``.
+
+    Returns f(subread_codes, subread_lens, consensus, consensus_len) ->
+    (polished, polished_len): re-pileups the subreads against the vote
+    consensus and applies the RNN — the medaka pass of the pipeline
+    (medaka_polish.py:95-144 analogue).
+    """
+    import jax.numpy as jnp_
+
+    from ont_tcrconsensus_tpu.ops import consensus as consensus_mod
+    from ont_tcrconsensus_tpu.ops import pileup as pileup_mod
+
+    def polish(codes, lens, cons, clen):
+        if clen == 0:
+            return cons, clen
+        base_at, ins_cnt, _, _ = pileup_mod.pileup_columns(
+            codes, lens, jnp_.asarray(cons), jnp_.int32(clen),
+            np.zeros(codes.shape[0], np.int32),
+            band_width=band_width, out_len=cons.shape[0],
+        )
+        feats = np.asarray(consensus_mod.pileup_features(base_at, ins_cnt, cons))
+        depth = (np.asarray(base_at) != pileup_mod.UNCOVERED).sum(axis=0)
+        return polish_draft(params, feats, cons, clen, depth=depth)
+
+    return polish
+
+
+# ---------------------------------------------------------------------------
+# training (in-repo, on the simulator's error model)
+
+
+def cross_entropy_loss(params, feats, labels, mask):
+    logits = apply_logits(params, feats)
+    logp = jax.nn.log_softmax(logits)
+    ll = jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def make_train_step(optimizer):
+    """Returns a jittable (params, opt_state, batch) -> (params, opt_state, loss)."""
+
+    def train_step(params, opt_state, feats, labels, mask):
+        loss, grads = jax.value_and_grad(cross_entropy_loss)(params, feats, labels, mask)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+def save_params(params, path: str) -> None:
+    import flax.serialization
+
+    with open(path, "wb") as fh:
+        fh.write(flax.serialization.to_bytes(params))
+
+
+def load_params(path: str) -> dict:
+    import flax.serialization
+
+    template = init_params()
+    with open(path, "rb") as fh:
+        return flax.serialization.from_bytes(template, fh.read())
+
+
+DEFAULT_WEIGHTS = os.path.join(os.path.dirname(__file__), "weights", "polisher_v1.msgpack")
+
+
+def load_default_params() -> dict | None:
+    """Bundled in-repo weights, or None when not present."""
+    if os.path.exists(DEFAULT_WEIGHTS):
+        return load_params(DEFAULT_WEIGHTS)
+    return None

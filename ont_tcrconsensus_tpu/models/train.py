@@ -1,0 +1,139 @@
+"""In-repo polisher training on the simulator's ONT error model.
+
+The reference ships medaka's externally-trained weights; here training is
+first-party (SURVEY §7 M3 adapted): examples are real pipeline states —
+a low-depth vote consensus (which still carries residual errors) plus its
+pileup features, labeled by aligning the true template to that draft. The
+RNN learns exactly the residual error distribution the vote stage leaves.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ont_tcrconsensus_tpu.io import simulator
+from ont_tcrconsensus_tpu.models import polisher
+from ont_tcrconsensus_tpu.ops import consensus, encode, pileup
+
+
+@dataclasses.dataclass
+class ExampleBatch:
+    feats: np.ndarray   # (N, W, F)
+    labels: np.ndarray  # (N, W) int32: 0-3 base, 4 deletion
+    mask: np.ndarray    # (N, W) float32: 1 where supervised
+
+
+def make_examples(
+    seed: int,
+    n_examples: int,
+    template_len: int = 256,
+    depth_range: tuple[int, int] = (3, 6),
+    err: tuple[float, float, float] = (0.03, 0.015, 0.015),
+    width: int = 512,
+    band_width: int = 128,
+) -> ExampleBatch:
+    """Build supervised examples from simulated low-depth clusters.
+
+    Labels: per draft position the true base (0-3) or 4 when the position is
+    an erroneous insertion in the draft (true deletion). Positions the truth
+    alignment does not cover are masked out.
+    """
+    rng = np.random.default_rng(seed)
+    feats_l, labels_l, mask_l = [], [], []
+    for _ in range(n_examples):
+        template = simulator._rand_seq(rng, template_len)
+        depth = int(rng.integers(depth_range[0], depth_range[1] + 1))
+        reads = []
+        for _ in range(depth):
+            s, _ = simulator.mutate(rng, template, *err)
+            reads.append(encode.encode_seq(s))
+        codes = np.full((depth, width), encode.PAD_CODE, np.uint8)
+        lens = np.zeros(depth, np.int32)
+        for i, r in enumerate(reads):
+            codes[i, : len(r)] = r
+            lens[i] = len(r)
+        draft, draft_len = consensus.consensus_cluster(
+            codes, lens, rounds=1, band_width=band_width, pad_to=width
+        )
+        if draft_len == 0:
+            continue
+        base_at, ins_cnt, _, _ = pileup.pileup_columns(
+            codes, lens, jnp.asarray(draft), jnp.int32(draft_len),
+            np.zeros(depth, np.int32), band_width=band_width, out_len=width,
+        )
+        feats = np.asarray(consensus.pileup_features(base_at, ins_cnt, draft))
+
+        # label by aligning the truth to the draft
+        truth = encode.encode_seq(template)
+        tcodes = np.full((1, width), encode.PAD_CODE, np.uint8)
+        tcodes[0, : len(truth)] = truth
+        t_base, _, _, t_span = pileup.pileup_columns(
+            tcodes, np.array([len(truth)], np.int32),
+            jnp.asarray(draft), jnp.int32(draft_len),
+            np.zeros(1, np.int32), band_width=band_width, out_len=width,
+        )
+        t_base = np.asarray(t_base)[0]
+        labels = np.where(t_base == pileup.UNCOVERED, 0, t_base).astype(np.int32)
+        mask = ((t_base != pileup.UNCOVERED) & (np.arange(width) < draft_len)).astype(np.float32)
+        feats_l.append(feats)
+        labels_l.append(labels)
+        mask_l.append(mask)
+    return ExampleBatch(
+        feats=np.stack(feats_l), labels=np.stack(labels_l), mask=np.stack(mask_l)
+    )
+
+
+def train(
+    steps: int = 300,
+    batch_size: int = 16,
+    lr: float = 1e-3,
+    seed: int = 0,
+    pool_examples: int = 192,
+    template_len: int = 256,
+    params=None,
+    log_every: int = 50,
+) -> tuple[dict, list[float]]:
+    """Train the polisher; returns (params, loss trace)."""
+    pool = make_examples(seed, pool_examples, template_len=template_len)
+    if params is None:
+        params = polisher.init_params(seed)
+    optimizer = optax.adam(lr)
+    opt_state = optimizer.init(params)
+    step_fn = polisher.make_train_step(optimizer)
+    import jax
+
+    step_fn = jax.jit(step_fn)
+    rng = np.random.default_rng(seed + 1)
+    losses = []
+    for s in range(steps):
+        idx = rng.integers(0, pool.feats.shape[0], size=batch_size)
+        params, opt_state, loss = step_fn(
+            params, opt_state,
+            jnp.asarray(pool.feats[idx]), jnp.asarray(pool.labels[idx]),
+            jnp.asarray(pool.mask[idx]),
+        )
+        losses.append(float(loss))
+        if log_every and s % log_every == 0:
+            print(f"step {s}: loss {float(loss):.4f}")
+    return params, losses
+
+
+def evaluate_accuracy(params, seed: int = 99, n_examples: int = 32) -> dict[str, float]:
+    """Per-position accuracy of the polisher vs the raw draft on held-out data."""
+    ex = make_examples(seed, n_examples)
+    logits = np.asarray(polisher.apply_logits(params, jnp.asarray(ex.feats)))
+    pred = logits.argmax(axis=-1)
+    m = ex.mask > 0
+    model_acc = float((pred[m] == ex.labels[m]).mean())
+    # baseline: the draft itself (class = draft base, never deletion);
+    # feats[..., 7:11] is the draft one-hot
+    draft_base = ex.feats[..., 7:11].argmax(axis=-1)
+    draft_is_base = ex.feats[..., 7:11].sum(axis=-1) > 0
+    base_acc = float(
+        ((draft_base[m] == ex.labels[m]) & draft_is_base[m]).mean()
+    )
+    return {"model_acc": model_acc, "draft_acc": base_acc}

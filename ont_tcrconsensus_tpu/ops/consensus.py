@@ -179,6 +179,71 @@ def consensus_cluster(
     return draft, int(draft_len)
 
 
+_vote_columns_batch = jax.jit(jax.vmap(vote_columns))
+
+
+def consensus_clusters_batch(
+    subreads: np.ndarray,
+    subread_lens: np.ndarray,
+    rounds: int = 4,
+    band_width: int = 128,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Batched :func:`consensus_cluster` over C same-shape clusters.
+
+    Args:
+      subreads: (C, S, W) uint8 dense codes (0-length rows = padding);
+      subread_lens: (C, S).
+
+    Returns (drafts (C, W), draft_lens (C,)). One device dispatch per round
+    covers every cluster — the per-cluster host loop only handles seed
+    selection, end extension, and convergence checks.
+    """
+    C, S, W = subreads.shape
+    subread_lens = np.asarray(subread_lens)
+    drafts = np.full((C, W), PAD_CODE, np.uint8)
+    dlens = np.zeros((C,), np.int32)
+    for c in range(C):
+        real = np.where(subread_lens[c] > 0)[0]
+        if len(real) == 0:
+            continue
+        order = real[np.argsort(subread_lens[c][real], kind="stable")]
+        seed = int(order[(len(real) - 1) // 2])
+        n = int(subread_lens[c, seed])
+        drafts[c, :n] = subreads[c, seed, :n]
+        dlens[c] = n
+
+    for _ in range(rounds):
+        base_at, ins_cnt, ins_base, spans = pileup.pileup_columns_batch(
+            subreads, subread_lens, jnp.asarray(drafts), jnp.asarray(dlens),
+            band_width=band_width, out_len=W,
+        )
+        new_drafts, new_lens = _vote_columns_batch(
+            base_at, ins_cnt, ins_base, jnp.asarray(drafts), jnp.asarray(dlens)
+        )
+        new_drafts = np.asarray(new_drafts)[:, :W]
+        new_lens = np.asarray(new_lens)
+        spans = np.asarray(spans)
+        all_unchanged = True
+        for c in range(C):
+            if dlens[c] == 0:
+                continue
+            if int(new_lens[c]) > W:
+                raise ValueError("consensus grew past the padded width")
+            cand = np.full((W,), PAD_CODE, np.uint8)
+            cand[:W] = new_drafts[c]
+            cand, nl = _extend_ends(
+                cand, int(new_lens[c]), subreads[c], subread_lens[c], spans[c],
+                int(dlens[c]),
+            )
+            unchanged = nl == dlens[c] and (cand[:nl] == drafts[c, :nl]).all()
+            drafts[c] = cand
+            dlens[c] = nl
+            all_unchanged &= bool(unchanged)
+        if all_unchanged:
+            break
+    return drafts, dlens
+
+
 @functools.partial(jax.jit, static_argnames=())
 def pileup_features(
     base_at: jax.Array, ins_cnt: jax.Array, draft: jax.Array

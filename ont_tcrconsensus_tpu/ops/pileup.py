@@ -33,7 +33,8 @@ from ont_tcrconsensus_tpu.ops.sw_align import (
     MATCH,
     MISMATCH,
     NEG,
-    _pairmax,
+    PAD_SENTINEL,
+    _shift_right,
     _shift_up,
 )
 
@@ -51,21 +52,24 @@ def _forward_banded(read, read_len, ref, ref_len, diag_offset, band_width, scori
     W = band_width
     c = W // 2
     L = read.shape[0]
-    Lr = ref.shape[0]
     iota = jnp.arange(W, dtype=jnp.int32)
     read_len = read_len.astype(jnp.int32)
     ref_len = ref_len.astype(jnp.int32)
     off = diag_offset.astype(jnp.int32)
 
     shift_up = _shift_up
-    pairmax = _pairmax
+    pad = L + W
+    ref_padded = jnp.concatenate([
+        jnp.full((pad,), PAD_SENTINEL, ref.dtype), ref, jnp.full((pad,), PAD_SENTINEL, ref.dtype)
+    ])
 
     def row_step(carry, i):
         H, E, best = carry
         jrow = i + off - c + iota
         valid = (jrow >= 0) & (jrow < ref_len) & (i < read_len)
         rbase = read[jnp.clip(i, 0, L - 1)]
-        tbase = ref[jnp.clip(jrow, 0, Lr - 1)]
+        start = jnp.clip(i + off - c + pad, 0, ref_padded.shape[0] - W)
+        tbase = jax.lax.dynamic_slice(ref_padded, (start,), (W,))
         is_match = (tbase == rbase) & (rbase < 4) & (tbase < 4)
         sub = jnp.where(is_match, match, -mismatch).astype(jnp.int32)
 
@@ -90,15 +94,24 @@ def _forward_banded(read, read_len, ref, ref_len, diag_offset, band_width, scori
         tmp = jnp.where(valid, tmp, NEG)
         tdir = tdir | jnp.where(e_open, jnp.uint8(_EOPEN_BIT), jnp.uint8(0))
 
-        g = jnp.where(tmp <= NEG // 2, NEG, tmp + gap_ext * iota)
-        gmax, gidx = jax.lax.associative_scan(pairmax, (g, iota))
-        gmax = jnp.concatenate([jnp.full((1,), NEG, jnp.int32), gmax[:-1]])
-        gidx = jnp.concatenate([jnp.zeros((1,), jnp.int32), gidx[:-1]])
-        F = gmax - gap_open - gap_ext * iota
+        # F via shift-doubling (see sw_align._f_cascade); the gap length is
+        # tracked alongside so the traceback jump needs no argmax/gather
+        g = tmp
+        gap = jnp.zeros_like(tmp)
+        step = 1
+        while step < W:
+            cand_g = _shift_right(g, step, NEG) - gap_ext * step
+            cand_gap = _shift_right(gap, step, 0) + step
+            take = cand_g > g
+            g = jnp.where(take, cand_g, g)
+            gap = jnp.where(take, cand_gap, gap)
+            step *= 2
+        F = _shift_right(g, 1, NEG) - gap_open - gap_ext
+        jump = (_shift_right(gap, 1, 0) + 1).astype(jnp.uint8)
 
         take_f = F > tmp
         H_new = jnp.where(valid, jnp.where(take_f, F, tmp), NEG)
-        fjump = jnp.where(take_f, (iota - gidx).astype(jnp.uint8), jnp.uint8(0))
+        fjump = jnp.where(take_f, jump, jnp.uint8(0))
 
         b_star = jnp.argmax(H_new).astype(jnp.int32)
         row_best = H_new[b_star]
@@ -237,4 +250,41 @@ def pileup_columns(
 
     return jax.vmap(one)(
         subreads, subread_lens.astype(jnp.int32), diag_offsets.astype(jnp.int32)
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("band_width", "out_len"))
+def pileup_columns_batch(
+    subreads: jax.Array,
+    subread_lens: jax.Array,
+    drafts: jax.Array,
+    draft_lens: jax.Array,
+    band_width: int = 128,
+    out_len: int | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Batched :func:`pileup_columns` over clusters.
+
+    Args:
+      subreads: (C, S, L); subread_lens: (C, S); drafts: (C, Ld);
+      draft_lens: (C,). Diag offsets are 0 (same-molecule subreads).
+
+    Returns (base_at (C,S,out_len), ins_cnt, ins_base, spans (C,S,4)).
+    """
+    if out_len is None:
+        out_len = drafts.shape[-1]
+    scoring = (MATCH, MISMATCH, GAP_OPEN, GAP_EXT)
+
+    def per_cluster(sub, slens, draft, dlen):
+        def one(read, rlen):
+            best, tdir, fjump = _forward_banded(
+                read, rlen, draft, dlen, jnp.int32(0), band_width, scoring
+            )
+            return _traceback_one(
+                best, tdir, fjump, read, jnp.int32(0), band_width, out_len
+            )
+
+        return jax.vmap(one)(sub, slens.astype(jnp.int32))
+
+    return jax.vmap(per_cluster)(
+        subreads, subread_lens, drafts, draft_lens.astype(jnp.int32)
     )

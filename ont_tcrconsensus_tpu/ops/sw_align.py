@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 NEG = jnp.int32(-(1 << 24))
+PAD_SENTINEL = 5  # encode.PAD_CODE: never matches (tbase < 4 check)
 
 MATCH = 2
 MISMATCH = 4   # penalty (positive)
@@ -80,11 +81,46 @@ def _shift_up(x, fill):
     return jnp.concatenate([x[1:], jnp.full((1,), fill, x.dtype)])
 
 
+def _shift_right(x, step, fill):
+    """x[b] -> x[b-step] (bring the value from `step` slots left)."""
+    return jnp.concatenate([jnp.full((step,), fill, x.dtype), x[:-step]])
+
+
+def _f_cascade(tmp, tch, gap_open, gap_ext, band_width):
+    """Ref-gap (F) values + channels via log2(W) shift-doubling.
+
+    R[b] = max_{l<=b}(tmp[l] - ext*(b-l)) with the origin's channels carried
+    through the selects and the gap length accumulated — no prefix scan, no
+    gathers, only elementwise ops and static shifts (TPU-friendly; the same
+    structure maps directly onto a future Pallas kernel). Ties keep the
+    shorter gap, matching the sequential Gotoh tie-break.
+    Then F[b] = R[b-1] - open - ext with one more gap column.
+    """
+    g = tmp
+    ch = tch
+    gap = jnp.zeros_like(tmp)
+    step = 1
+    while step < band_width:
+        cand_g = _shift_right(g, step, NEG) - gap_ext * step
+        cand_ch = jnp.stack([_shift_right(ch[k], step, 0) for k in range(ch.shape[0])])
+        cand_gap = _shift_right(gap, step, 0) + step
+        take = cand_g > g
+        g = jnp.where(take, cand_g, g)
+        ch = jnp.where(take[None, :], cand_ch, ch)
+        gap = jnp.where(take, cand_gap, gap)
+        step *= 2
+    F = _shift_right(g, 1, NEG) - gap_open - gap_ext
+    Fch = jnp.stack([_shift_right(ch[k], 1, 0) for k in range(ch.shape[0])])
+    Fgap = _shift_right(gap, 1, 0) + 1
+    Fch = Fch.at[1].add(Fgap)  # the gap run adds Fgap columns
+    return F, Fch
+
+
 def _align_one(read, read_len, ref, ref_len, diag_offset, band_width, scoring):
     match, mismatch, gap_open, gap_ext = scoring
     W = band_width
     c = W // 2
-    Lr = ref.shape[0]
+    L = read.shape[0]
     iota = jnp.arange(W, dtype=jnp.int32)
     read_len = read_len.astype(jnp.int32)
     ref_len = ref_len.astype(jnp.int32)
@@ -92,24 +128,19 @@ def _align_one(read, read_len, ref, ref_len, diag_offset, band_width, scoring):
 
     shift_up = _shift_up
 
-    # channel layout: 0=n_match, 1=n_cols, 2=read_start, 3=ref_start.
-    # A fresh (empty) alignment at band cell (i, jrow) has consumed
-    # read[0..i] / ref[0..jrow], so it starts at (i+1, jrow+1).
-    def fresh_channels(i, jrow):
-        return jnp.stack([
-            jnp.zeros((W,), jnp.int32),
-            jnp.zeros((W,), jnp.int32),
-            jnp.full((W,), i + 1, jnp.int32),
-            jrow + 1,
-        ])
+    # ref padded so each row's band window is one contiguous dynamic slice
+    pad = L + W
+    ref_padded = jnp.concatenate([
+        jnp.full((pad,), PAD_SENTINEL, ref.dtype), ref, jnp.full((pad,), PAD_SENTINEL, ref.dtype)
+    ])
 
     def row_step(carry, i):
         H, Hch, E, Ech, best = carry
         jrow = i + off - c + iota
-        in_ref = (jrow >= 0) & (jrow < ref_len)
-        valid = in_ref & (i < read_len)
-        rbase = read[jnp.clip(i, 0, read.shape[0] - 1)]
-        tbase = ref[jnp.clip(jrow, 0, Lr - 1)]
+        valid = (jrow >= 0) & (jrow < ref_len) & (i < read_len)
+        rbase = read[jnp.clip(i, 0, L - 1)]
+        start = jnp.clip(i + off - c + pad, 0, ref_padded.shape[0] - W)
+        tbase = jax.lax.dynamic_slice(ref_padded, (start,), (W,))
         is_match = (tbase == rbase) & (rbase < 4) & (tbase < 4)
         sub = jnp.where(is_match, match, -mismatch).astype(jnp.int32)
 
@@ -143,7 +174,15 @@ def _align_one(read, read_len, ref, ref_len, diag_offset, band_width, scoring):
         Dch = Dch.at[0].add(is_match.astype(jnp.int32)).at[1].add(1)
 
         # tmp = max(D, E, fresh) with priority D >= E >= fresh
-        fch = fresh_channels(i, jrow)
+        # channel layout: 0=n_match, 1=n_cols, 2=read_start, 3=ref_start.
+        # A fresh (empty) alignment at band cell (i, jrow) has consumed
+        # read[0..i] / ref[0..jrow], so it starts at (i+1, jrow+1).
+        fch = jnp.stack([
+            jnp.zeros((W,), jnp.int32),
+            jnp.zeros((W,), jnp.int32),
+            jnp.full((W,), i + 1, jnp.int32),
+            jrow + 1,
+        ])
         tmp = D
         tch = Dch
         e_better = E_new > tmp
@@ -154,14 +193,8 @@ def _align_one(read, read_len, ref, ref_len, diag_offset, band_width, scoring):
         tch = jnp.where(f_better[None, :], fch, tch)
         tmp = jnp.where(valid, tmp, NEG)
 
-        # F: ref-consuming gap within the row — max-plus prefix scan with argmax
-        g = jnp.where(tmp <= NEG // 2, NEG, tmp + gap_ext * iota)
-        gmax, gidx = jax.lax.associative_scan(_pairmax, (g, iota))
-        # exclusive: predecessor strictly left
-        gmax = jnp.concatenate([jnp.full((1,), NEG, jnp.int32), gmax[:-1]])
-        gidx = jnp.concatenate([jnp.zeros((1,), jnp.int32), gidx[:-1]])
-        F = gmax - gap_open - gap_ext * iota
-        Fch = jnp.take(tch, gidx, axis=1).at[1].add(iota - gidx)
+        # F: ref-consuming gap within the row, via shift-doubling
+        F, Fch = _f_cascade(tmp, tch, gap_open, gap_ext, W)
 
         take_f = F > tmp
         H_new = jnp.where(valid, jnp.where(take_f, F, tmp), NEG)
@@ -189,7 +222,7 @@ def _align_one(read, read_len, ref, ref_len, diag_offset, band_width, scoring):
     best0 = jnp.concatenate([jnp.array([0], jnp.int32), jnp.zeros((6,), jnp.int32)])
     init = (H0, ch0, H0, ch0, best0)
     (_, _, _, _, best), _ = jax.lax.scan(
-        init=init, xs=jnp.arange(read.shape[0], dtype=jnp.int32), f=row_step
+        init=init, xs=jnp.arange(L, dtype=jnp.int32), f=row_step
     )
     return best
 

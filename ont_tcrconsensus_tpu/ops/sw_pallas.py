@@ -1,0 +1,285 @@
+"""Pallas TPU kernel for the banded affine SW forward pass.
+
+Same semantics as :func:`.sw_align.align_banded` (verified against the same
+numpy oracle), but the whole row recurrence runs inside one kernel with the
+DP state resident in VMEM — the XLA scan version writes its ~10 KB/pair
+carry to HBM every row, which caps it at ~0.2 Gcell/s; keeping the carry
+on-chip removes that traffic entirely.
+
+Layout tricks:
+- the reference is pre-shifted on the host into ``ref_shifted[b, k] =
+  ref[k + off_b - W/2]`` so every row's band window is ONE contiguous
+  ``pl.ds(i, W)`` slice shared by the whole pair-block — no per-pair
+  gathers inside the kernel;
+- the F (ref-gap) cascade is the shift-doubling max-plus form
+  (sw_align._f_cascade) — elementwise selects and static lane shifts only;
+- the best cell is tracked per (pair, band-slot) with its row index, and
+  the cross-lane argmax + tie-break (earliest row, then smallest slot,
+  matching the sequential kernel) happens once, outside the kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ont_tcrconsensus_tpu.ops.sw_align import (
+    GAP_EXT,
+    GAP_OPEN,
+    MATCH,
+    MISMATCH,
+    PAD_SENTINEL,
+    AlignResult,
+)
+
+_NEG = -(1 << 24)  # python int: jnp constants get captured by pallas_call
+BLK = 16  # pairs per program
+
+
+def align_banded_auto(*args, **kwargs) -> AlignResult:
+    """Pallas on an accelerator backend, the XLA scan kernel on CPU.
+
+    Both kernels are cell-exact equals (asserted by tests on both paths),
+    so the dispatch is purely a performance choice.
+    """
+    import ont_tcrconsensus_tpu.ops.sw_align as sw_align
+
+    if jax.default_backend() == "cpu":
+        return sw_align.align_banded(*args, **kwargs)
+    return align_banded_pallas(*args, **kwargs)
+
+
+def _kernel(read_ref, refsh_ref, rlen_ref, tlen_ref, off_ref,
+            bestH_ref, bestRow_ref, bm_ref, bc_ref, brs_ref, bfs_ref,
+            *, L, W, match, mismatch, gap_open, gap_ext):
+    c = W // 2
+    iota = jax.lax.broadcasted_iota(jnp.int32, (BLK, W), 1)
+    rlen = rlen_ref[:]          # (BLK, 1)
+    tlen = tlen_ref[:]
+    off = off_ref[:]
+    neg = jnp.full((BLK, W), _NEG, jnp.int32)
+    zero = jnp.zeros((BLK, W), jnp.int32)
+
+    lane128 = jax.lax.broadcasted_iota(jnp.int32, (BLK, 128), 1)
+
+    def shift_up(x, fill):
+        return jnp.concatenate([x[:, 1:], jnp.full((BLK, 1), fill, x.dtype)], axis=1)
+
+    def shift_right(x, step, fill):
+        return jnp.concatenate([jnp.full((BLK, step), fill, x.dtype), x[:, :-step]], axis=1)
+
+    def elem_at(ref, k):
+        """ref[:, k] as (BLK, 1) — Mosaic needs lane offsets that are
+        multiples of 128, so load the aligned 128-chunk and lane-select."""
+        base = pl.multiple_of((k // 128) * 128, 128)
+        chunk = ref[:, pl.ds(base, 128)].astype(jnp.int32)
+        sel = lane128 == (k % 128)
+        return jnp.sum(jnp.where(sel, chunk, 0), axis=1, keepdims=True)
+
+    def body(i, carry):
+        (H, Hm, Hc, Hrs, Hfs, E, Em, Ec, Ers, Efs,
+         bH, bRow, bm, bc, brs, bfs, window) = carry
+        jrow = i + off - c + iota                      # (BLK, W)
+        valid = (jrow >= 0) & (jrow < tlen) & (i < rlen)
+        rbase = elem_at(read_ref, i)                   # (BLK, 1)
+        tbase = window                                 # (BLK, W)
+        is_match = (tbase == rbase) & (rbase < 4) & (tbase < 4)
+        sub = jnp.where(is_match, match, -mismatch)
+        # advance the band window one ref position for the next row
+        window = jnp.concatenate([window[:, 1:], elem_at(refsh_ref, i + W)], axis=1)
+
+        # E: read-consuming gap from (i-1, j) = prev row, slot b+1
+        H_up = shift_up(H, _NEG)
+        E_up = shift_up(E, _NEG)
+        open_sc = H_up - gap_open - gap_ext
+        ext_sc = E_up - gap_ext
+        t_open = open_sc >= ext_sc
+        E_new = jnp.where(t_open, open_sc, ext_sc)
+        Em_n = jnp.where(t_open, shift_up(Hm, 0), shift_up(Em, 0))
+        Ec_n = jnp.where(t_open, shift_up(Hc, 0), shift_up(Ec, 0)) + 1
+        Ers_n = jnp.where(t_open, shift_up(Hrs, 0), shift_up(Ers, 0))
+        Efs_n = jnp.where(t_open, shift_up(Hfs, 0), shift_up(Efs, 0))
+
+        # diagonal (with fresh-at-predecessor 0-clamp)
+        t_fresh = 0 > H
+        D = jnp.where(t_fresh, 0, H) + sub
+        Dm = jnp.where(t_fresh, zero, Hm) + is_match.astype(jnp.int32)
+        Dc = jnp.where(t_fresh, zero, Hc) + 1
+        Drs = jnp.where(t_fresh, jnp.broadcast_to(jnp.full((BLK, 1), i, jnp.int32), (BLK, W)), Hrs)
+        Dfs = jnp.where(t_fresh, jrow, Hfs)
+
+        # tmp = max(D, E, fresh) with priority D >= E >= fresh
+        tmp, tm, tc, trs, tfs = D, Dm, Dc, Drs, Dfs
+        e_b = E_new > tmp
+        tmp = jnp.where(e_b, E_new, tmp)
+        tm = jnp.where(e_b, Em_n, tm)
+        tc = jnp.where(e_b, Ec_n, tc)
+        trs = jnp.where(e_b, Ers_n, trs)
+        tfs = jnp.where(e_b, Efs_n, tfs)
+        f_b = 0 > tmp
+        tmp = jnp.where(f_b, 0, tmp)
+        tm = jnp.where(f_b, zero, tm)
+        tc = jnp.where(f_b, zero, tc)
+        trs = jnp.where(f_b, jnp.broadcast_to(jnp.full((BLK, 1), i + 1, jnp.int32), (BLK, W)), trs)
+        tfs = jnp.where(f_b, jrow + 1, tfs)
+        tmp = jnp.where(valid, tmp, neg)
+
+        # F cascade: shift-doubling with channel/gap tracking
+        g, gm, gc, grs, gfs, gap = tmp, tm, tc, trs, tfs, zero
+        step = 1
+        while step < W:
+            cg = shift_right(g, step, _NEG) - gap_ext * step
+            take = cg > g
+            g = jnp.where(take, cg, g)
+            gm = jnp.where(take, shift_right(gm, step, 0), gm)
+            gc = jnp.where(take, shift_right(gc, step, 0), gc)
+            grs = jnp.where(take, shift_right(grs, step, 0), grs)
+            gfs = jnp.where(take, shift_right(gfs, step, 0), gfs)
+            gap = jnp.where(take, shift_right(gap, step, 0) + step, gap)
+            step *= 2
+        F = shift_right(g, 1, _NEG) - gap_open - gap_ext
+        Fgap = shift_right(gap, 1, 0) + 1
+        Fm = shift_right(gm, 1, 0)
+        Fc = shift_right(gc, 1, 0) + Fgap
+        Frs = shift_right(grs, 1, 0)
+        Ffs = shift_right(gfs, 1, 0)
+
+        t_f = F > tmp
+        H_new = jnp.where(valid, jnp.where(t_f, F, tmp), neg)
+        Hm_n = jnp.where(t_f, Fm, tm)
+        Hc_n = jnp.where(t_f, Fc, tc)
+        Hrs_n = jnp.where(t_f, Frs, trs)
+        Hfs_n = jnp.where(t_f, Ffs, tfs)
+
+        # per-slot best (strict improvement keeps the earliest row)
+        imp = H_new > bH
+        bH = jnp.where(imp, H_new, bH)
+        bRow = jnp.where(imp, jnp.broadcast_to(jnp.full((BLK, 1), i, jnp.int32), (BLK, W)), bRow)
+        bm = jnp.where(imp, Hm_n, bm)
+        bc = jnp.where(imp, Hc_n, bc)
+        brs = jnp.where(imp, Hrs_n, brs)
+        bfs = jnp.where(imp, Hfs_n, bfs)
+
+        E_new = jnp.where(valid, E_new, neg)
+        return (H_new, Hm_n, Hc_n, Hrs_n, Hfs_n,
+                E_new, Em_n, Ec_n, Ers_n, Efs_n,
+                bH, bRow, bm, bc, brs, bfs, window)
+
+    window0 = refsh_ref[:, 0:W].astype(jnp.int32)
+    init = (neg, zero, zero, zero, zero,
+            neg, zero, zero, zero, zero,
+            jnp.zeros((BLK, W), jnp.int32), jnp.full((BLK, W), -1, jnp.int32),
+            zero, zero, zero, zero, window0)
+    out = jax.lax.fori_loop(0, L, body, init)
+    bestH_ref[:] = out[10]
+    bestRow_ref[:] = out[11]
+    bm_ref[:] = out[12]
+    bc_ref[:] = out[13]
+    brs_ref[:] = out[14]
+    bfs_ref[:] = out[15]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("band_width", "match", "mismatch", "gap_open", "gap_ext", "interpret"),
+)
+def align_banded_pallas(
+    reads: jax.Array,
+    read_lens: jax.Array,
+    refs: jax.Array,
+    ref_lens: jax.Array,
+    diag_offsets: jax.Array,
+    band_width: int = 256,
+    match: int = MATCH,
+    mismatch: int = MISMATCH,
+    gap_open: int = GAP_OPEN,
+    gap_ext: int = GAP_EXT,
+    interpret: bool = False,
+) -> AlignResult:
+    """Drop-in Pallas replacement for ``sw_align.align_banded``.
+
+    The batch is padded up to a multiple of BLK pairs; ``interpret=True``
+    runs the kernel in the Pallas interpreter (CPU tests).
+    """
+    B0, L = reads.shape
+    W = band_width
+    c = W // 2
+    B = ((B0 + BLK - 1) // BLK) * BLK
+
+    def pad_to(x, n, fill):
+        if x.shape[0] == n:
+            return x
+        pad_shape = (n - x.shape[0],) + x.shape[1:]
+        return jnp.concatenate([x, jnp.full(pad_shape, fill, x.dtype)])
+
+    reads_p = pad_to(jnp.asarray(reads), B, PAD_SENTINEL)
+    refs_p = pad_to(jnp.asarray(refs), B, PAD_SENTINEL)
+    rlens = pad_to(jnp.asarray(read_lens, jnp.int32), B, 0)[:, None]
+    tlens = pad_to(jnp.asarray(ref_lens, jnp.int32), B, 0)[:, None]
+    offs = pad_to(jnp.asarray(diag_offsets, jnp.int32), B, 0)[:, None]
+
+    # host-side pre-shift: ref_shifted[b, k] = ref[b, k + off_b - c]
+    K = L + W
+    ks = jnp.arange(K, dtype=jnp.int32)[None, :] + offs - c  # (B, K)
+    in_range = (ks >= 0) & (ks < refs_p.shape[1])
+    ref_shifted = jnp.where(
+        in_range,
+        jnp.take_along_axis(refs_p, jnp.clip(ks, 0, refs_p.shape[1] - 1), axis=1),
+        jnp.uint8(PAD_SENTINEL),
+    )
+
+    kernel = functools.partial(
+        _kernel, L=L, W=W, match=match, mismatch=mismatch,
+        gap_open=gap_open, gap_ext=gap_ext,
+    )
+    grid = (B // BLK,)
+    row_spec = lambda shape_cols: pl.BlockSpec(
+        (BLK, shape_cols), lambda g: (g, 0), memory_space=pltpu.VMEM
+    )
+    out_shapes = [jax.ShapeDtypeStruct((B, W), jnp.int32)] * 6
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            row_spec(L),      # reads
+            row_spec(K),      # ref_shifted
+            row_spec(1),      # read lens
+            row_spec(1),      # ref lens
+            row_spec(1),      # offsets
+        ],
+        out_specs=[row_spec(W)] * 6,
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(reads_p, ref_shifted, rlens, tlens, offs)
+    bestH, bestRow, bm, bc, brs, bfs = outs
+
+    # final cross-slot selection with the sequential tie-break:
+    # max score, then earliest row, then smallest slot
+    score = jnp.max(bestH, axis=1)
+    is_max = bestH == score[:, None]
+    row_or_inf = jnp.where(is_max, bestRow, jnp.int32(1 << 30))
+    best_row = jnp.min(row_or_inf, axis=1)
+    cand = is_max & (bestRow == best_row[:, None])
+    slot = jnp.argmax(cand, axis=1)  # first matching slot
+
+    def take(x):
+        return jnp.take_along_axis(x, slot[:, None], axis=1)[:, 0]
+
+    offs0 = offs[:, 0]
+    jrow_best = best_row + offs0 - c + slot.astype(jnp.int32)
+    aligned = score > 0
+    res = AlignResult(
+        score=score[:B0],
+        read_start=jnp.where(aligned, take(brs), 0)[:B0],
+        read_end=jnp.where(aligned, best_row + 1, 0)[:B0],
+        ref_start=jnp.where(aligned, take(bfs), 0)[:B0],
+        ref_end=jnp.where(aligned, jrow_best + 1, 0)[:B0],
+        n_match=jnp.where(aligned, take(bm), 0)[:B0],
+        n_cols=jnp.where(aligned, take(bc), 0)[:B0],
+    )
+    return res

@@ -1,0 +1,111 @@
+"""Device-mesh management and sharded pipeline execution.
+
+The reference scales by Ray CPU tasks on one node (SURVEY §2.3: task/data
+parallelism over libraries and region clusters; no model/tensor parallelism
+exists). The TPU-native equivalents:
+
+- **data axis** ("data"): read/cluster batches sharded across chips; the
+  alignment, pileup, and clustering kernels are embarrassingly parallel over
+  their batch dimension, so sharding the inputs lets XLA run them with zero
+  collectives (the all-reduce appears only in summaries/losses).
+- **model axis** ("model"): tensor parallelism for the polisher's dense/GRU
+  feature dimensions — overkill for this model's size, but it exercises the
+  tp path the dryrun validates.
+- multi-host: the same meshes span hosts via ``jax.distributed`` — the data
+  axis then shards by barcode library, mirroring the reference's
+  per-library Ray fan-out (tcr_consensus.py:141-167), with collectives
+  riding ICI within a host and DCN across hosts.
+
+Nothing here requires N physical chips: tests and the driver's dryrun use
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` CPU devices.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(shape: dict[str, int] | None = None, devices=None) -> Mesh:
+    """Build a mesh; default puts every device on the data axis.
+
+    ``shape`` e.g. {"data": 4, "model": 2}; axis sizes must multiply to the
+    device count used.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if not shape:
+        shape = {"data": len(devices)}
+    names = tuple(shape)
+    sizes = tuple(shape[n] for n in names)
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(f"mesh {shape} needs {total} devices, have {len(devices)}")
+    arr = np.array(devices[:total]).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def data_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Shard the leading (batch) axis over the data axis; rest replicated."""
+    return NamedSharding(mesh, P("data", *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, *arrays):
+    """device_put each array with its leading axis on the data axis.
+
+    Leading dimensions must divide the data-axis size; callers pad batches
+    (the pipeline's static-shape batching already guarantees this for
+    power-of-two batch sizes).
+    """
+    out = []
+    for a in arrays:
+        out.append(jax.device_put(a, data_sharding(mesh, np.ndim(a))))
+    return tuple(out) if len(out) > 1 else out[0]
+
+
+def polisher_param_sharding(mesh: Mesh, params) -> dict:
+    """Tensor-parallel layout for the polisher: Dense kernels split on the
+    output-feature axis over "model"; biases and GRU cells replicated.
+
+    (The reference has no model parallelism at all — SURVEY §2.3; this is
+    the TP story for the one neural component in the pipeline.)
+    """
+    has_model = "model" in mesh.axis_names
+
+    def spec_for(path, leaf):
+        name = "/".join(str(p.key) for p in path if hasattr(p, "key"))
+        if has_model and leaf.ndim == 2 and name.endswith("kernel"):
+            if "embed" in name:
+                # column-parallel: split the hidden (output) features
+                return NamedSharding(mesh, P(None, "model"))
+            if "head" in name:
+                # row-parallel: the class dim (5) is indivisible, split inputs
+                return NamedSharding(mesh, P("model", None))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def sharded_train_step(mesh: Mesh, optimizer):
+    """The polisher train step jitted over the mesh: dp on the batch,
+    tp on the dense kernels. Returns (step_fn, place_params, place_batch)."""
+    from ont_tcrconsensus_tpu.models import polisher as polisher_mod
+
+    base_step = polisher_mod.make_train_step(optimizer)
+
+    def place_params(params):
+        return jax.device_put(params, polisher_param_sharding(mesh, params))
+
+    def place_batch(feats, labels, mask):
+        return (
+            jax.device_put(feats, data_sharding(mesh, 3)),
+            jax.device_put(labels, data_sharding(mesh, 2)),
+            jax.device_put(mask, data_sharding(mesh, 2)),
+        )
+
+    return jax.jit(base_step), place_params, place_batch

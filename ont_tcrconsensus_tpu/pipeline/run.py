@@ -29,6 +29,7 @@ from ont_tcrconsensus_tpu.cluster import regions as regions_mod
 from ont_tcrconsensus_tpu.io import fastx, layout
 from ont_tcrconsensus_tpu.pipeline import stages
 from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+from ont_tcrconsensus_tpu.qc import artifacts, umi_overlap
 
 # fallback precision bar when no reference pair survives the homology filter
 # (the reference would crash there; see cluster/regions.py docstring)
@@ -46,6 +47,14 @@ def run_pipeline(config_path: str, polisher=None) -> dict[str, dict[str, int]]:
 
 
 def run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]:
+    if polisher is None and cfg.polish_method == "rnn":
+        from ont_tcrconsensus_tpu.models import polisher as polisher_mod
+
+        params = polisher_mod.load_default_params()
+        if params is not None:
+            polisher = polisher_mod.make_pipeline_polisher(params)
+        else:
+            _log("polish_method=rnn but no bundled weights; using vote consensus only")
     reference = fastx.read_fasta_dict(cfg.reference_file)
     nano_dir = os.path.join(cfg.fastq_pass_dir, "nano_tcr")
     if os.path.exists(nano_dir) and not cfg.resume:
@@ -61,6 +70,10 @@ def run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]:
         json.dump(homology.region_cluster, fh, indent=4)
     with open(os.path.join(nano_dir, "self_homology_stats.json"), "w") as fh:
         json.dump(homology.stats, fh, indent=4)
+    artifacts.write_self_homology_log(
+        homology.stats,
+        os.path.join(nano_dir, "ref_homology_out_generate_region_split_dict.log"),
+    )
 
     blast_id_threshold = cfg.blast_id_threshold
     overlap_consensus = cfg.minimal_region_overlap_consensus
@@ -141,6 +154,14 @@ def _run_library(fastq, lay, cfg, panel, blast_id_threshold, overlap_consensus,
     _write_align_log(astats, os.path.join(lay.logs, f"{library}_region_cluster_split.log"))
     groups = stages.split_by_region_cluster(aligned, panel)
     stages.write_region_fastas(groups, lay.region_cluster_fasta, "region_cluster")
+    artifacts.write_region_split_log(
+        astats, groups, panel.names,
+        {n: len(s) for n, s in panel.seqs.items()},
+        regions_mod.NEGATIVE_CONTROL_SUFFIXES,
+        os.path.join(
+            lay.logs, f"{library}_filter_and_split_reads_by_region_cluster.err"
+        ),
+    )
 
     # round 1: UMI extract / cluster / select / consensus, per region cluster
     merged_consensus: list[tuple[str, str]] = []
@@ -192,6 +213,7 @@ def _run_round2(lay, cfg, panel, blast_id_threshold, overlap_consensus,
     # round 2: consensus align + blast-id filter + split by exact region
     _log("Aligning unique molecule consensus TCR sequences:", library)
     cons_records = [fastx.FastxRecord(h, "", s) for h, s in merged_consensus]
+    qc_rows: list[dict] = []
     cons_aligned, cstats = stages.assign_reads(
         cons_records, panel,
         minimal_region_overlap=overlap_consensus,
@@ -201,13 +223,22 @@ def _run_round2(lay, cfg, panel, blast_id_threshold, overlap_consensus,
         top_k=4,
         max_read_length=cfg.max_read_length,
         blast_id_threshold=blast_id_threshold,
+        collect_qc=qc_rows,
     )
-    _write_align_log(cstats, os.path.join(lay.logs, f"{library}_merged_consensus_bam_filter.log"))
+    artifacts.write_consensus_filter_artifacts(
+        qc_rows,
+        {n: len(s) for n, s in panel.seqs.items()},
+        lay.logs,
+        "merged_consensus",
+        blast_id_threshold=blast_id_threshold,
+        minimal_region_overlap=overlap_consensus,
+    )
     region_groups = stages.split_by_region(cons_aligned, panel)
     stages.write_region_fastas(region_groups, lay.region_fasta, "region_")
 
     # round 2: UMI extract + dedup clustering at consensus identity
     region_counts: dict[str, int] = {}
+    region_cluster_umis: dict[str, list[str]] = {}
     for region, reads_in_region in sorted(region_groups.items()):
         reads = [(r.name, r.seq, r.strand) for r in reads_in_region]
         umis = stages.extract_umis_stage(
@@ -242,8 +273,14 @@ def _run_round2(lay, cfg, panel, blast_id_threshold, overlap_consensus,
         # the reference counts smolecule headers (count.py:9-20): the written
         # members, capped by the selection math — not the cluster count
         region_counts[region] = len(entries)
+        region_cluster_umis[region] = [cl.members[0].combined for cl in selected]
 
     stages.write_counts_csv(region_counts, lay.counts)
+    if cfg.compare_umi_overlap_between_regions:
+        _log("Testing for consensus umi matches between regions:", library)
+        umi_overlap.count_overlapping_umis(
+            region_cluster_umis, lay.logs, cfg.overlapping_umi_edit_threshold
+        )
     lay.mark_stage_done("counts")
 
     if cfg.delete_tmp_files:

@@ -19,7 +19,7 @@ import numpy as np
 from ont_tcrconsensus_tpu.cluster import umi as umi_mod
 from ont_tcrconsensus_tpu.io import bucketing, fastx
 from ont_tcrconsensus_tpu.ops import consensus as consensus_mod
-from ont_tcrconsensus_tpu.ops import ee_filter, encode, fuzzy_match, sketch, sw_align
+from ont_tcrconsensus_tpu.ops import ee_filter, encode, fuzzy_match, sketch, sw_pallas
 
 # ---------------------------------------------------------------------------
 # reference panel
@@ -137,6 +137,7 @@ def assign_reads(
     min_score: int = 100,
     max_read_length: int = 4096,
     blast_id_threshold: float | None = None,
+    collect_qc: list | None = None,
 ) -> tuple[list[AlignedRead], AlignStats]:
     """Align every read to its best reference region; apply region filters.
 
@@ -168,7 +169,7 @@ def assign_reads(
         for c in range(top_k):
             ridx = cand_idx[:, c]
             offs = sketch.diag_offset(lens, panel.lens[ridx]).astype(np.int32)
-            res = sw_align.align_banded(
+            res = sw_pallas.align_banded_auto(
                 oriented, lens, panel.codes[ridx], panel.lens[ridx], offs,
                 band_width=band_width,
             )
@@ -191,19 +192,42 @@ def assign_reads(
             ridx = int(best["ridx"][i])
             rlen = panel.region_len(ridx)
             ref_span = int(best["ref_end"][i]) - int(best["ref_start"][i])
+            qc = {
+                "name": batch.ids[i].partition(" ")[0],
+                "region": panel.names[ridx],
+                "ref_span": ref_span,
+                "read_len": int(lens[i]),
+                "region_len": rlen,
+                "blast_id": float(best["blast_id"][i]),
+            }
             if ref_span < rlen * minimal_region_overlap:
                 stats.n_short += 1
+                if collect_qc is not None:
+                    qc["status"] = "short"
+                    qc["nt_short"] = rlen * minimal_region_overlap - ref_span
+                    collect_qc.append(qc)
                 continue
-            if int(lens[i]) > rlen * (2 - minimal_region_overlap) + (
+            max_len = rlen * (2 - minimal_region_overlap) + (
                 max_softclip_5_end + max_softclip_3_end
-            ):
+            )
+            if int(lens[i]) > max_len:
                 stats.n_long += 1
+                if collect_qc is not None:
+                    qc["status"] = "long"
+                    qc["nt_long"] = int(lens[i]) - max_len
+                    collect_qc.append(qc)
                 continue
             if blast_id_threshold is not None and not (
                 float(best["blast_id"][i]) > blast_id_threshold
             ):
+                if collect_qc is not None:
+                    qc["status"] = "low_blast_id"
+                    collect_qc.append(qc)
                 continue
             stats.n_pass += 1
+            if collect_qc is not None:
+                qc["status"] = "pass"
+                collect_qc.append(qc)
             name, _, _ = batch.ids[i].partition(" ")
             out.append(AlignedRead(
                 name=name,
@@ -455,6 +479,7 @@ def polish_clusters_stage(
     rounds: int = 4,
     band_width: int = 128,
     polisher=None,
+    cluster_batch: int = 16,
 ) -> list[tuple[str, str]]:
     """Consensus per selected cluster; returns (header, sequence) pairs.
 
@@ -462,18 +487,22 @@ def polish_clusters_stage(
     ``<group>_<clusterN>_<n_subreads>`` (medaka_polish.py:146-180).
     Subreads enter in canonical (+) orientation — strand is known from
     alignment, so no internal re-orientation pass is needed.
+
+    Static-shape discipline: clusters are grouped by (subread-count bucket,
+    width bucket) and processed in batches of ``cluster_batch`` through one
+    device dispatch per round (``consensus_clusters_batch``), so XLA
+    compiles one kernel per shape bucket instead of one per cluster.
+    Padding rows have length 0: they score 0 and cast no votes.
     """
-    out: list[tuple[str, str]] = []
+    prepared: dict[tuple[int, int], list[tuple[SelectedCluster, np.ndarray, np.ndarray]]] = (
+        defaultdict(list)
+    )
     for cl in selected:
         seqs = [
             m.seq if m.strand == "+" else encode.revcomp_str(m.seq)
             for m in cl.members
         ]
-        # static-shape discipline: width from the global length buckets (with
-        # one lane-width of growth slack) and subread count padded to a
-        # power-of-two bucket, so XLA compiles one kernel per (S, W) bucket
-        # instead of one per cluster. Padding rows have length 0: the pileup
-        # kernel scores them 0 and they cast no votes.
+        # one lane-width of growth slack above the longest subread
         need = max(len(s) for s in seqs) + 128
         width = min(
             max_read_length,
@@ -489,13 +518,34 @@ def polish_clusters_stage(
                 [codes, np.full((pad_rows, codes.shape[1]), encode.PAD_CODE, np.uint8)]
             )
             lens = np.concatenate([lens, np.zeros(pad_rows, lens.dtype)])
-        cons, clen = consensus_mod.consensus_cluster(
-            codes, lens, rounds=rounds, band_width=band_width, pad_to=codes.shape[1]
-        )
-        if polisher is not None:
-            cons, clen = polisher(codes, lens, cons, clen)
-        seq = encode.decode_seq(cons, clen)
-        out.append((f"{group_name}_cluster{cl.cluster_id}_{len(cl.members)}", seq))
+        prepared[(s_bucket, codes.shape[1])].append((cl, codes, lens))
+
+    out: list[tuple[str, str]] = []
+    for (s_bucket, width), items in sorted(prepared.items()):
+        for start in range(0, len(items), cluster_batch):
+            chunk = items[start : start + cluster_batch]
+            C = len(chunk)
+            sub = np.stack([codes for _, codes, _ in chunk])
+            lens = np.stack([ln for _, _, ln in chunk])
+            if C < cluster_batch:  # pad the cluster axis: stable compile shapes
+                pad = cluster_batch - C
+                sub = np.concatenate(
+                    [sub, np.full((pad, s_bucket, width), encode.PAD_CODE, np.uint8)]
+                )
+                lens = np.concatenate([lens, np.zeros((pad, s_bucket), lens.dtype)])
+            drafts, dlens = consensus_mod.consensus_clusters_batch(
+                sub, lens, rounds=rounds, band_width=band_width
+            )
+            for c in range(C):
+                cl = chunk[c][0]
+                cons, clen = drafts[c], int(dlens[c])
+                if polisher is not None:
+                    cons, clen = polisher(sub[c], lens[c], cons, clen)
+                seq = encode.decode_seq(cons, clen)
+                out.append(
+                    (f"{group_name}_cluster{cl.cluster_id}_{len(cl.members)}", seq)
+                )
+    out.sort(key=lambda kv: int(kv[0].rsplit("_cluster", 1)[1].split("_")[0]))
     return out
 
 

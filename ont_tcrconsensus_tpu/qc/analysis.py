@@ -1,0 +1,401 @@
+"""Post-hoc QC analysis and reporting.
+
+Rebuild of the reference's analysis layer (/root/reference/
+ont_tcr_consensus/analysis.py, 1232 LoC) and its driver notebook
+(notebooks/analysis.ipynb): log parsers, count transforms, distribution
+fits, sensitivity summaries and the plot set, writing per-library PDFs
+under ``outs/``. Parsers target THIS framework's artifact formats (which
+keep the reference's filenames); each function cites its reference
+analogue.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import defaultdict
+
+import numpy as np
+
+# matplotlib is imported lazily inside plot functions so headless/quick runs
+# never pay for it
+
+
+# ---------------------------------------------------------------------------
+# parsers (analysis.py:76-114 analogues, reading our log formats)
+
+
+def parse_merged_consensus_bam_filter_log(log_path: str) -> dict[str, float]:
+    """Key/value parse of merged_consensus_bam_filter.log
+    (analysis.py:84-105 parses the reference log by line index; ours parses
+    by label so reordering cannot silently break it)."""
+    out: dict[str, float] = {}
+    labels = {
+        "Total # primary alignments": "n_primary",
+        "# primary alignments with allowed length": "n_correct_len",
+        "# alignments too short": "n_short",
+        "# alignments too long": "n_long",
+        "# written alignments passing blast id filter": "n_written",
+        "- minimal region overlap": "minimal_region_overlap",
+        "- minimal blast identity with reference": "blast_id_threshold",
+    }
+    with open(log_path) as fh:
+        for line in fh:
+            for label, key in labels.items():
+                if line.startswith(label):
+                    out[key] = float(line.rstrip().rsplit(":", 1)[1])
+    return out
+
+
+def parse_quantile_95_blast_id_from_self_homology_log(log_path: str) -> float | None:
+    """analysis.py:108-114 analogue."""
+    with open(log_path) as fh:
+        for line in fh:
+            if line.startswith("0.950 quantile blast identity"):
+                return float(line.rstrip().rsplit(":", 1)[1])
+    return None
+
+
+def read_counts_csv(path: str) -> dict[str, int]:
+    out: dict[str, int] = {}
+    with open(path) as fh:
+        next(fh, None)
+        for line in fh:
+            region, _, count = line.rstrip("\n").rpartition(",")
+            if region:
+                out[region] = int(count)
+    return out
+
+
+def read_two_column_csv(path: str) -> list[tuple[str, float]]:
+    rows = []
+    with open(path) as fh:
+        next(fh, None)
+        for line in fh:
+            a, _, b = line.rstrip("\n").rpartition(",")
+            if a:
+                rows.append((a, float(b)))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# count transforms (analysis.py:560-574)
+
+
+def filter_counts_on_log_umi_count_threshold(
+    counts: dict[str, int], log10_threshold: float
+) -> dict[str, int]:
+    """Keep regions with log10(count) >= threshold (analysis.py:573)."""
+    return {
+        region: c for region, c in counts.items()
+        if c > 0 and np.log10(c) >= log10_threshold
+    }
+
+
+def negative_control_counts(
+    counts: dict[str, int],
+    suffixes: tuple[str, ...] = ("_v_n", "cdr3j_n", "full_n"),
+) -> dict[str, int]:
+    """Spiked-negative-control subset (analysis.py:53-73)."""
+    return {r: c for r, c in counts.items() if r.endswith(suffixes)}
+
+
+def fit_count_distributions(counts: list[int]) -> dict[str, float]:
+    """Negative-binomial + normal fits with KS tests (analysis.py:577-811).
+
+    The NB is moment-fit (r from mean/variance); KS p-values quantify how
+    well each family explains the per-region UMI count spread.
+    """
+    from scipy import stats as sps
+
+    x = np.asarray([c for c in counts if c > 0], dtype=np.float64)
+    out: dict[str, float] = {"n": float(x.size)}
+    if x.size < 3:
+        return out
+    mean, var = float(x.mean()), float(x.var(ddof=1))
+    out["mean"] = mean
+    out["var"] = var
+    # normal fit
+    ks_norm = sps.kstest(x, "norm", args=(mean, max(np.sqrt(var), 1e-9)))
+    out["ks_normal_p"] = float(ks_norm.pvalue)
+    # negative binomial via moments (var > mean required)
+    if var > mean:
+        r = mean**2 / (var - mean)
+        p = r / (r + mean)
+        out["nb_r"] = float(r)
+        out["nb_p"] = float(p)
+        nb = sps.nbinom(r, p)
+        ks_nb = sps.kstest(x, nb.cdf)
+        out["ks_nbinom_p"] = float(ks_nb.pvalue)
+    return out
+
+
+def estimate_precision_at_num_subreads(
+    subread_blast_rows: list[tuple[str, float]],
+    perfect_id: float = 1.0,
+) -> dict[int, dict[str, float]]:
+    """Consensus precision as a function of UMI cluster depth
+    (minimap2_align.py:362-435, offline tool).
+
+    For each subread count: how many consensus sequences exist, and what
+    fraction align to the reference with blast identity >= ``perfect_id``.
+    """
+    per_depth: dict[int, list[float]] = defaultdict(list)
+    for n, blast_id in subread_blast_rows:
+        if str(n).isdigit():
+            per_depth[int(n)].append(blast_id)
+    return {
+        n: {
+            "n_consensus": len(ids),
+            "n_perfect": sum(1 for b in ids if b >= perfect_id),
+            "precision": sum(1 for b in ids if b >= perfect_id) / len(ids),
+        }
+        for n, ids in sorted(per_depth.items())
+    }
+
+
+# ---------------------------------------------------------------------------
+# summary / sensitivity (analysis.py:814-911)
+
+
+def write_results_summary(
+    counts: dict[str, int],
+    reference_regions: set[str],
+    out_path: str,
+    log10_threshold: float | None = None,
+    negative_suffixes: tuple[str, ...] = ("_v_n", "cdr3j_n", "full_n"),
+) -> dict[str, float]:
+    """Sensitivity vs reference + negative-control leakage report."""
+    countable = {r for r in reference_regions if not r.endswith(negative_suffixes)}
+    detected = {r for r, c in counts.items() if c > 0 and not r.endswith(negative_suffixes)}
+    filtered = (
+        filter_counts_on_log_umi_count_threshold(counts, log10_threshold)
+        if log10_threshold is not None else counts
+    )
+    detected_filtered = {
+        r for r in filtered if not r.endswith(negative_suffixes)
+    }
+    ncs = negative_control_counts(counts, negative_suffixes)
+    summary = {
+        "num_reference_regions": len(countable),
+        "num_detected": len(countable & detected),
+        "sensitivity": (len(countable & detected) / len(countable)) if countable else 0.0,
+        "num_detected_after_threshold": len(countable & detected_filtered),
+        "num_negative_controls_with_counts": sum(1 for c in ncs.values() if c > 0),
+        "total_negative_control_counts": sum(ncs.values()),
+        "total_umi_counts": sum(counts.values()),
+    }
+    missing = sorted(countable - detected)
+    with open(out_path, "w") as fh:
+        for k, v in summary.items():
+            fh.write(f"{k}: {v}\n")
+        fh.write(f"missing_regions ({len(missing)}): {missing}\n")
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# plots (analysis.py:117-557, 577-811, 914-1232) — matplotlib PDFs
+
+
+def _savefig(fig, out_path):
+    fig.tight_layout()
+    fig.savefig(out_path)
+    import matplotlib.pyplot as plt
+
+    plt.close(fig)
+
+
+def plot_blast_id_hist(region_blast_rows: list[tuple[str, float]], out_path: str,
+                       threshold: float | None = None):
+    """Consensus blast-id distribution (analysis.py:117-228)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    vals = [b for _, b in region_blast_rows]
+    fig, ax = plt.subplots(figsize=(6, 4))
+    ax.hist(vals, bins=60)
+    if threshold is not None:
+        ax.axvline(threshold, color="red", linestyle="--", label=f"threshold {threshold:.4f}")
+        ax.legend()
+    ax.set_xlabel("blast identity vs reference")
+    ax.set_ylabel("# consensus sequences")
+    _savefig(fig, out_path)
+
+
+def plot_nt_length_deviation_hists(short_rows, long_rows, out_path: str):
+    """Too-short / too-long alignment histograms (analysis.py:231-325)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, axes = plt.subplots(1, 2, figsize=(10, 4))
+    axes[0].hist([v for _, v in short_rows], bins=40)
+    axes[0].set_xlabel("nt short of minimal overlap")
+    axes[1].hist([v for _, v in long_rows], bins=40)
+    axes[1].set_xlabel("nt past maximal length")
+    for ax in axes:
+        ax.set_ylabel("# alignments")
+    _savefig(fig, out_path)
+
+
+def plot_subreads_per_umi_hist(subread_rows: list[tuple[str, float]], out_path: str):
+    """Subreads-per-UMI histogram (analysis.py:393-434)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    ns = [int(n) for n, _ in subread_rows if str(n).isdigit()]
+    fig, ax = plt.subplots(figsize=(6, 4))
+    ax.hist(ns, bins=np.arange(0.5, (max(ns) if ns else 1) + 1.5))
+    ax.set_xlabel("# subreads per UMI cluster")
+    ax.set_ylabel("# clusters")
+    _savefig(fig, out_path)
+
+
+def plot_blast_id_vs_subreads_box(subread_rows: list[tuple[str, float]], out_path: str):
+    """Blast-id-vs-subreads boxplots (analysis.py:437-557)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    groups: dict[int, list[float]] = defaultdict(list)
+    for n, b in subread_rows:
+        if str(n).isdigit():
+            groups[int(n)].append(b)
+    keys = sorted(groups)
+    fig, ax = plt.subplots(figsize=(8, 4))
+    if keys:
+        ax.boxplot([groups[k] for k in keys], tick_labels=[str(k) for k in keys])
+    ax.set_xlabel("# subreads")
+    ax.set_ylabel("blast identity")
+    _savefig(fig, out_path)
+
+
+def plot_umi_count_hist(counts: dict[str, int], out_path: str,
+                        log10_threshold: float | None = None,
+                        negative_suffixes=("_v_n", "cdr3j_n", "full_n")):
+    """UMI count histogram with negative-control overlay + fit annotations
+    (analysis.py:577-811)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    pos = [c for r, c in counts.items() if c > 0 and not r.endswith(negative_suffixes)]
+    neg = [c for r, c in counts.items() if c > 0 and r.endswith(negative_suffixes)]
+    fig, ax = plt.subplots(figsize=(7, 4))
+    bins = np.logspace(0, np.log10(max(pos + neg + [10])), 40)
+    ax.hist(pos, bins=bins, alpha=0.7, label="TCR regions")
+    if neg:
+        ax.hist(neg, bins=bins, alpha=0.7, color="red", label="negative controls")
+    if log10_threshold is not None:
+        ax.axvline(10**log10_threshold, color="black", linestyle="--",
+                   label=f"log10 threshold {log10_threshold}")
+    ax.set_xscale("log")
+    ax.set_xlabel("UMI count")
+    ax.set_ylabel("# regions")
+    fits = fit_count_distributions(pos)
+    if "ks_nbinom_p" in fits:
+        ax.set_title(
+            f"NB fit r={fits['nb_r']:.2f} (KS p={fits['ks_nbinom_p']:.3f}); "
+            f"normal KS p={fits['ks_normal_p']:.3f}", fontsize=9,
+        )
+    ax.legend()
+    _savefig(fig, out_path)
+
+
+def plot_plate_heatmap(counts: dict[str, int], out_path: str,
+                       rows: int = 16, cols: int = 24):
+    """384-well plate heatmap (analysis.py:914-993). Region names are mapped
+    to wells in sorted order when they don't carry explicit well ids."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    grid = np.full((rows, cols), np.nan)
+    for i, region in enumerate(sorted(counts)):
+        if i >= rows * cols:
+            break
+        grid[i // cols, i % cols] = counts[region]
+    fig, ax = plt.subplots(figsize=(10, 6))
+    im = ax.imshow(grid, aspect="auto", cmap="viridis")
+    fig.colorbar(im, ax=ax, label="UMI count")
+    ax.set_xlabel("plate column")
+    ax.set_ylabel("plate row")
+    _savefig(fig, out_path)
+
+
+# ---------------------------------------------------------------------------
+# per-library driver (notebook cell 3 analogue)
+
+
+def run_library_analysis(
+    library_dir: str,
+    reference_regions: set[str],
+    out_dir: str | None = None,
+    log10_threshold: float | None = None,
+) -> dict[str, float]:
+    """Produce the per-library outs/ PDFs + results_summary.txt."""
+    out_dir = out_dir or os.path.join(library_dir, "outs")
+    os.makedirs(out_dir, exist_ok=True)
+    logs = os.path.join(library_dir, "logs")
+    counts = read_counts_csv(os.path.join(library_dir, "counts", "umi_consensus_counts.csv"))
+
+    blast_csv = os.path.join(logs, "merged_consensus_region_blast_id.csv")
+    if os.path.exists(blast_csv):
+        rows = read_two_column_csv(blast_csv)
+        plot_blast_id_hist(rows, os.path.join(out_dir, "blast_id_hist.pdf"))
+    short_csv = os.path.join(logs, "merged_consensus_region_nt_too_short.csv")
+    long_csv = os.path.join(logs, "merged_consensus_region_nt_too_long.csv")
+    if os.path.exists(short_csv) and os.path.exists(long_csv):
+        plot_nt_length_deviation_hists(
+            read_two_column_csv(short_csv), read_two_column_csv(long_csv),
+            os.path.join(out_dir, "nt_length_deviation.pdf"),
+        )
+    sub_csv = os.path.join(logs, "merged_consensus_number_of_subreads_blast_id.csv")
+    if os.path.exists(sub_csv):
+        rows = read_two_column_csv(sub_csv)
+        plot_subreads_per_umi_hist(rows, os.path.join(out_dir, "subreads_per_umi.pdf"))
+        plot_blast_id_vs_subreads_box(rows, os.path.join(out_dir, "blast_id_vs_subreads.pdf"))
+    plot_umi_count_hist(counts, os.path.join(out_dir, "umi_count_hist.pdf"),
+                        log10_threshold=log10_threshold)
+    plot_plate_heatmap(counts, os.path.join(out_dir, "plate_heatmap.pdf"))
+    return write_results_summary(
+        counts, reference_regions,
+        os.path.join(out_dir, "results_summary.txt"),
+        log10_threshold=log10_threshold,
+    )
+
+
+def run_all_libraries(nano_dir: str, reference_regions: set[str],
+                      libraries_csv: str | None = None) -> dict[str, dict]:
+    """Loop all per-library dirs (notebook cells 1+3).
+
+    ``libraries.csv`` (README.md:62-82) columns: barcode, library_name,
+    ref_library_name, log_umi_counts_filter_threshold. Absent -> every
+    library dir under nano_dir with no threshold."""
+    thresholds: dict[str, float | None] = {}
+    if libraries_csv and os.path.exists(libraries_csv):
+        with open(libraries_csv) as fh:
+            next(fh, None)
+            for line in fh:
+                parts = [p.strip() for p in line.split(",")]
+                if len(parts) >= 4 and parts[0]:
+                    try:
+                        thresholds[parts[0]] = float(parts[3])
+                    except ValueError:
+                        thresholds[parts[0]] = None
+    out = {}
+    for name in sorted(os.listdir(nano_dir)):
+        lib_dir = os.path.join(nano_dir, name)
+        if not os.path.isdir(os.path.join(lib_dir, "counts")):
+            continue
+        out[name] = run_library_analysis(
+            lib_dir, reference_regions, log10_threshold=thresholds.get(name)
+        )
+    return out

@@ -1,0 +1,53 @@
+"""Cross-region UMI collision audit.
+
+Replicates ``count_overlapping_umis_between_all_regions``
+(/root/reference/ont_tcr_consensus/extract_umis.py:270-369): for every pair
+of regions, count round-2 cluster-consensus UMIs appearing in both. The
+reference's shipped code compares UMIs by EXACT equality (its fuzzy edlib
+variant is commented out, :282-289); we replicate the exact-match semantics
+with a hash join — O(total UMIs) instead of O(regions^2 * UMIs^2) of Ray
+tasks — and emit the same TSV/stderr artifacts.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+from collections import Counter
+
+
+def count_overlapping_umis(
+    region_umis: dict[str, list[str]],
+    logs_dir: str,
+    overlapping_umi_edit_threshold: int = 1,
+) -> list[bool]:
+    """region -> cluster UMIs; writes regions_w_overlapping_umis.tsv.
+
+    Returns per-region-pair booleans in ``itertools.combinations`` order,
+    matching the reference's return value.
+    """
+    tsv_path = os.path.join(logs_dir, "regions_w_overlapping_umis.tsv")
+    err_path = os.path.join(logs_dir, "region_region_umi_comparison.stderr")
+    with open(tsv_path, "a") as fh:
+        fh.write("region_1\tregion_2\tumi_overlap_count\n")
+
+    counters = {region: Counter(umis) for region, umis in region_umis.items()}
+    out: list[bool] = []
+    for r1, r2 in itertools.combinations(region_umis, 2):
+        c1, c2 = counters[r1], counters[r2]
+        if len(c1) > len(c2):
+            c1, c2 = c2, c1
+        # reference counts, per region-1 UMI, how many region-2 UMIs equal it
+        overlap = sum(n1 * c2.get(umi, 0) for umi, n1 in c1.items())
+        multi_warn = any(c2.get(umi, 0) > 1 for umi in c1)
+        if multi_warn:
+            with open(err_path, "a") as ferr:
+                ferr.write(
+                    f"WARNING: there are UMIs from {r1} that match more than 1 "
+                    f"UMI within {r2}\n"
+                )
+        if overlap:
+            with open(tsv_path, "a") as fh:
+                fh.write(f"region_{r1}\tregion_{r2}\t{overlap}\n")
+        out.append(bool(overlap))
+    return out

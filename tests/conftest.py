@@ -7,9 +7,16 @@ the driver separately dry-runs the multi-chip path via __graft_entry__.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The environment pre-sets JAX_PLATFORMS=axon (the TPU tunnel) and the axon
+# plugin re-prepends itself over the env var, so the config API is the only
+# reliable override: tests must run on the 8-device virtual CPU mesh, not
+# hog the real chip.
+import jax  # noqa: E402  (import after XLA_FLAGS is set)
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,78 @@
+"""Native C++ fastx parser vs the pure-Python reference parser."""
+
+import numpy as np
+import pytest
+
+from ont_tcrconsensus_tpu.io import fastx
+from ont_tcrconsensus_tpu.io import native
+from ont_tcrconsensus_tpu.ops import encode
+
+pytestmark = pytest.mark.skipif(
+    native.load() is None, reason="no C++ toolchain for the native parser"
+)
+
+
+def _compare(path):
+    parsed = native.parse_file(path)
+    assert parsed is not None
+    py_records = list(fastx.read_fastx(path))
+    assert parsed.num_records == len(py_records)
+    for i, rec in enumerate(py_records):
+        name, codes, quals = parsed.record(i)
+        assert name == rec.header
+        np.testing.assert_array_equal(codes, encode.encode_seq(rec.sequence))
+        if rec.quality is not None:
+            want = np.frombuffer(rec.quality.encode(), np.uint8) - 33
+            np.testing.assert_array_equal(quals, want)
+        else:
+            assert quals is None
+
+
+def test_fastq_gz_matches_python(tmp_path):
+    path = tmp_path / "x.fastq.gz"
+    fastx.write_fastq(path, [
+        ("r1 extra=1", "ACGTN", "IIIII"),
+        ("r2", "GGTTAACC", "!!!!!!!!"),
+    ])
+    _compare(str(path))
+
+
+def test_fasta_multiline_matches_python(tmp_path):
+    path = tmp_path / "x.fasta"
+    fastx.write_fasta(path, [("a desc", "ACGT" * 40), ("b", "TTTTA")], width=13)
+    _compare(str(path))
+
+
+def test_blank_lines_tolerated(tmp_path):
+    path = tmp_path / "x.fastq"
+    path.write_text("@r1\nACGT\n+\nIIII\n\n\n@r2\nGG\n+\nII\n")
+    parsed = native.parse_file(str(path))
+    assert parsed.num_records == 2
+    assert parsed.names == ["r1", "r2"]
+
+
+def test_malformed_raises(tmp_path):
+    path = tmp_path / "bad.fastq"
+    path.write_text("@r1\nACGT\n+\nII\n")  # qual length mismatch
+    with pytest.raises(ValueError, match="qual length"):
+        native.parse_file(str(path))
+
+
+def test_large_roundtrip_speed(tmp_path):
+    import time
+
+    from ont_tcrconsensus_tpu.io import simulator
+
+    lib = simulator.simulate_library(seed=3, num_regions=4)
+    path = tmp_path / "big.fastq.gz"
+    fastx.write_fastq(path, lib.reads)
+    t0 = time.time()
+    parsed = native.parse_file(str(path))
+    native_dt = time.time() - t0
+    assert parsed.num_records == len(lib.reads)
+    t0 = time.time()
+    n_py = sum(1 for _ in fastx.read_fastx(path))
+    py_dt = time.time() - t0
+    assert n_py == parsed.num_records
+    # informational; tiny inputs may not show a gap
+    print(f"native {native_dt * 1e3:.1f}ms vs python {py_dt * 1e3:.1f}ms")

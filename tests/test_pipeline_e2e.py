@@ -87,6 +87,31 @@ def test_pipeline_consensus_sequences_exact(sim_library):
     )
 
 
+def test_pipeline_rnn_polish_keeps_counts_exact(sim_library, tmp_path):
+    """The confidence-gated RNN pass must never corrupt a correct consensus."""
+    from ont_tcrconsensus_tpu.models import polisher as polisher_mod
+
+    if polisher_mod.load_default_params() is None:
+        pytest.skip("no bundled polisher weights")
+    tmp, lib = sim_library
+    import shutil
+
+    root = tmp_path / "rnn"
+    shutil.copytree(tmp / "fastq_pass" / "barcode01", root / "fastq_pass" / "barcode01")
+    shutil.copy(tmp / "reference.fa", root / "reference.fa")
+    cfg = RunConfig.from_dict({
+        "reference_file": str(root / "reference.fa"),
+        "fastq_pass_dir": str(root / "fastq_pass"),
+        "minimal_length": 1000,
+        "min_reads_per_cluster": 4,
+        "read_batch_size": 128,
+        "polish_method": "rnn",
+        "delete_tmp_files": False,
+    })
+    results = run_with_config(cfg)
+    assert results["barcode01"] == lib.true_counts
+
+
 def test_pipeline_resume_skips_completed(sim_library):
     tmp, lib = sim_library
     cfg = _base_config(tmp)

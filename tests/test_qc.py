@@ -1,0 +1,124 @@
+"""QC artifacts, UMI overlap audit, and the analysis layer."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ont_tcrconsensus_tpu.qc import analysis, artifacts, umi_overlap
+
+
+def _qc_rows():
+    return [
+        {"name": "rc0_cluster0_8", "region": "TCR1", "ref_span": 1500,
+         "read_len": 1600, "region_len": 1500, "blast_id": 0.999, "status": "pass"},
+        {"name": "rc0_cluster1_5", "region": "TCR1", "ref_span": 1200,
+         "read_len": 1600, "region_len": 1500, "blast_id": 0.99,
+         "status": "short", "nt_short": 225.0},
+        {"name": "rc1_cluster0_4", "region": "TCR2", "ref_span": 1500,
+         "read_len": 3400, "region_len": 1500, "blast_id": 0.99,
+         "status": "long", "nt_long": 1743.0},
+        {"name": "rc1_cluster2_6", "region": "TCR2", "ref_span": 1510,
+         "read_len": 1610, "region_len": 1500, "blast_id": 0.97,
+         "status": "low_blast_id"},
+    ]
+
+
+def test_consensus_filter_artifacts(tmp_path):
+    paths = artifacts.write_consensus_filter_artifacts(
+        _qc_rows(), {"TCR1": 1500, "TCR2": 1500}, str(tmp_path),
+        "merged_consensus", blast_id_threshold=0.995, minimal_region_overlap=0.95,
+    )
+    for key in ("nt_too_short", "region_nt_too_short", "nt_too_long",
+                "region_nt_too_long", "blast_id", "region_blast_id",
+                "num_subreads_blast_id", "log"):
+        assert os.path.exists(paths[key]), key
+    blast = (tmp_path / "merged_consensus_region_blast_id.csv").read_text().splitlines()
+    assert blast[0] == "region,blast_id"
+    assert len(blast) == 3  # pass + low_blast rows reach the blast CSV
+    sub = (tmp_path / "merged_consensus_number_of_subreads_blast_id.csv").read_text().splitlines()
+    assert sub[1].startswith("8,")
+    log = (tmp_path / "merged_consensus_bam_filter.log").read_text()
+    assert "Total # primary alignments: 4" in log
+    assert "# written alignments passing blast id filter: 1" in log
+
+
+def test_bam_filter_log_roundtrip(tmp_path):
+    artifacts.write_consensus_filter_artifacts(
+        _qc_rows(), {"TCR1": 1500, "TCR2": 1500}, str(tmp_path),
+        "merged_consensus", blast_id_threshold=0.995, minimal_region_overlap=0.95,
+    )
+    parsed = analysis.parse_merged_consensus_bam_filter_log(
+        str(tmp_path / "merged_consensus_bam_filter.log")
+    )
+    assert parsed["n_primary"] == 4
+    assert parsed["n_short"] == 1
+    assert parsed["n_long"] == 1
+    assert parsed["n_written"] == 1
+    assert parsed["blast_id_threshold"] == pytest.approx(0.995)
+
+
+def test_umi_overlap_audit(tmp_path):
+    region_umis = {
+        "TCR1": ["AAAA", "CCCC"],
+        "TCR2": ["AAAA", "GGGG"],
+        "TCR3": ["TTTT"],
+    }
+    flags = umi_overlap.count_overlapping_umis(region_umis, str(tmp_path))
+    # pairs in combinations order: (1,2)=True, (1,3)=False, (2,3)=False
+    assert flags == [True, False, False]
+    tsv = (tmp_path / "regions_w_overlapping_umis.tsv").read_text().splitlines()
+    assert tsv[1] == "region_TCR1\tregion_TCR2\t1"
+
+
+def test_count_transforms_and_fits():
+    counts = {"a": 100, "b": 120, "c": 3, "nc_full_n": 1}
+    kept = analysis.filter_counts_on_log_umi_count_threshold(counts, 1.0)
+    assert set(kept) == {"a", "b"}
+    assert analysis.negative_control_counts(counts) == {"nc_full_n": 1}
+    rng = np.random.default_rng(0)
+    x = rng.negative_binomial(20, 0.2, size=200).tolist()
+    fits = analysis.fit_count_distributions(x)
+    assert fits["ks_nbinom_p"] > 0.01
+
+
+def test_precision_at_num_subreads():
+    rows = [("4", 1.0), ("4", 0.999), ("8", 1.0), ("8", 1.0), ("x", 1.0)]
+    est = analysis.estimate_precision_at_num_subreads(rows)
+    assert est[4]["n_consensus"] == 2 and est[4]["n_perfect"] == 1
+    assert est[4]["precision"] == pytest.approx(0.5)
+    assert est[8]["precision"] == 1.0
+    assert "x" not in est and 0 not in est
+
+
+def test_results_summary(tmp_path):
+    counts = {"TCR1": 50, "TCR2": 0, "NC_full_n": 2}
+    summary = analysis.write_results_summary(
+        counts, {"TCR1", "TCR2", "NC_full_n"}, str(tmp_path / "summary.txt"),
+    )
+    assert summary["num_reference_regions"] == 2
+    assert summary["num_detected"] == 1
+    assert summary["sensitivity"] == pytest.approx(0.5)
+    assert summary["num_negative_controls_with_counts"] == 1
+    text = (tmp_path / "summary.txt").read_text()
+    assert "missing_regions (1): ['TCR2']" in text
+
+
+def test_library_analysis_pdfs(tmp_path):
+    lib = tmp_path / "barcode01"
+    (lib / "logs").mkdir(parents=True)
+    (lib / "counts").mkdir()
+    artifacts.write_consensus_filter_artifacts(
+        _qc_rows(), {"TCR1": 1500, "TCR2": 1500}, str(lib / "logs"),
+        "merged_consensus", blast_id_threshold=0.995, minimal_region_overlap=0.95,
+    )
+    (lib / "counts" / "umi_consensus_counts.csv").write_text(
+        "TCR,Count\nTCR1,40\nTCR2,25\n"
+    )
+    summary = analysis.run_library_analysis(str(lib), {"TCR1", "TCR2"})
+    outs = os.listdir(lib / "outs")
+    for pdf in ("blast_id_hist.pdf", "umi_count_hist.pdf", "plate_heatmap.pdf",
+                "subreads_per_umi.pdf", "blast_id_vs_subreads.pdf",
+                "nt_length_deviation.pdf", "results_summary.txt"):
+        assert pdf in outs, pdf
+    assert summary["sensitivity"] == 1.0

@@ -103,6 +103,42 @@ def test_amplicon_geometry():
     assert len(read) - int(res.read_end[0]) <= overhang + 10
 
 
+def test_pallas_kernel_matches_jnp_kernel():
+    """Interpreter-mode Pallas vs the scan kernel: identical results."""
+    from ont_tcrconsensus_tpu.ops import sw_pallas
+
+    rng = np.random.default_rng(7)
+    reads_l, refs_l, offs = [], [], []
+    for t in range(6):
+        ref = rng.integers(0, 4, int(rng.integers(60, 120))).astype(np.uint8)
+        read = list(ref)
+        for _ in range(6):
+            p = int(rng.integers(len(read)))
+            op = rng.integers(3)
+            if op == 0:
+                read.insert(p, int(rng.integers(4)))
+            elif op == 1 and len(read) > 10:
+                del read[p]
+            else:
+                read[p] = (read[p] + 1) % 4
+        reads_l.append(np.array(read, np.uint8))
+        refs_l.append(ref)
+        offs.append(0)
+    reads, rlens = _pad(reads_l, 128)
+    refs, tlens = _pad(refs_l, 128)
+    offs = np.array(offs, np.int32)
+
+    want = sw_align.align_banded(reads, rlens, refs, tlens, offs, band_width=128)
+    got = sw_pallas.align_banded_pallas(
+        reads, rlens, refs, tlens, offs, band_width=128, interpret=True
+    )
+    for f in ("score", "read_start", "read_end", "ref_start", "ref_end",
+              "n_match", "n_cols"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, f)), np.asarray(getattr(want, f)), err_msg=f
+        )
+
+
 def test_batch_is_elementwise():
     rng = np.random.default_rng(4)
     seqs = [rng.integers(0, 4, int(rng.integers(50, 120))).astype(np.uint8) for _ in range(6)]
