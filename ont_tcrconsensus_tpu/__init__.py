@@ -14,12 +14,14 @@ JAX kernels:
 - ``models``    Flax consensus-polisher RNN (medaka-class bi-GRU).
 - ``cluster``   greedy centroid UMI clustering and reference self-homology
                 region clustering driven by device distance batches.
-- ``parallel``  mesh management, sharded pipeline steps, wavefront sequence
-                parallelism, HBM batch budgeting.
+- ``parallel``  device-mesh management (data-sharded pipeline batches via
+                shard_map, tensor-parallel polisher training) and the HBM
+                batch budgeter.
 - ``io``        host data plane: FASTQ/FASTA streaming, encoding, batching,
                 a C++ fast parser, and a read simulator.
-- ``pipeline``  the end-to-end two-round UMI consensus pipeline, config and
-                stage-level resume.
+- ``pipeline``  the end-to-end two-round UMI consensus pipeline: the fused
+                per-batch device pass (trim/filter/align/UMI), columnar read
+                store, config and stage-level resume.
 - ``qc``        QC artifacts, stats and analysis plots.
 """
 

@@ -93,6 +93,61 @@ def batch_reads(
             yield flush(w)
 
 
+def batch_parsed_reads(
+    parsed,
+    batch_size: int = 2048,
+    widths: Sequence[int] = DEFAULT_WIDTHS,
+    min_len: int = 1,
+) -> Iterator[ReadBatch]:
+    """Batches straight from a columnar :class:`..native.ParsedFastx` parse.
+
+    The native C++ parser returns dense codes + offsets; bucketing becomes a
+    vectorized ``searchsorted`` and each batch is filled by row slicing —
+    no per-read Python record objects on the ingest path (the pysam-loop
+    replacement the reference cannot have, SURVEY §7 hard-part 5).
+    Emission order matches :func:`batch_reads` on the same file: input order
+    within a bucket, buckets flushed when full and at end-of-stream in
+    first-seen order.
+    """
+    lens = np.asarray(parsed.lengths)
+    widths_arr = np.asarray(widths)
+    bucket_idx = np.searchsorted(widths_arr, lens)  # widths[i-1] < len <= widths[i]
+    eligible = (lens >= min_len) & (bucket_idx < len(widths_arr))
+    has_quals = parsed.quals is not None
+
+    pending: dict[int, list[int]] = {int(w): [] for w in widths}
+
+    def flush(w: int) -> ReadBatch:
+        rows = pending[w]
+        pending[w] = []
+        B = batch_size
+        codes = np.full((B, w), encode.PAD_CODE, dtype=np.uint8)
+        quals = np.full((B, w), 93, dtype=np.uint8) if has_quals else None
+        blens = np.zeros((B,), dtype=np.int32)
+        valid = np.zeros((B,), dtype=bool)
+        ids: list[str] = []
+        for i, r in enumerate(rows):
+            s, e = parsed.offsets[r], parsed.offsets[r + 1]
+            codes[i, : e - s] = parsed.codes[s:e]
+            if has_quals:
+                quals[i, : e - s] = parsed.quals[s:e]
+            blens[i] = e - s
+            valid[i] = True
+            ids.append(parsed.names[r])
+        ids.extend([""] * (B - len(rows)))
+        return ReadBatch(codes=codes, quals=quals, lengths=blens, valid=valid,
+                         ids=ids, width=w)
+
+    for r in np.where(eligible)[0]:
+        w = int(widths_arr[bucket_idx[r]])
+        pending[w].append(int(r))
+        if len(pending[w]) == batch_size:
+            yield flush(w)
+    for w in widths:
+        if pending[int(w)]:
+            yield flush(int(w))
+
+
 def _make_batch(recs: list, width: int, batch_size: int, with_quals: bool) -> ReadBatch:
     B = batch_size
     n = len(recs)

@@ -37,6 +37,13 @@ _IUPAC_CHOICES = {
 LEFT_FLANK = "CAAGCAGAAGACGGCATACGAGAT"
 RIGHT_FLANK = "AATGATACGGCGACCACCGAGATC"
 
+# Full UVP primers (adapter+GSP) for untrimmed-read simulation: the amplicon
+# carries the forward primer at its 5' end and the reverse complement of the
+# reverse primer at its 3' end, exactly what the trim stage must remove
+# (dorado trim --primer-sequences analogue; reference primers/primers.fasta).
+PRIMER_FWD = "CAAGCAGAAGACGGCATACGAGATGTATCGTGTAGAGACTGCGTAGG"
+PRIMER_REV = "AATGATACGGCGACCACCGAGATCAGTGATCGAGTCAGTGCGAGTG"
+
 
 def _rand_seq(rng: np.random.Generator, n: int) -> str:
     return "".join(_BASES[rng.integers(0, 4, size=n)])
@@ -164,12 +171,19 @@ def simulate_library(
     umi_fwd_pattern: str = "TTTVVTTVVVVTTVVVVTTVVVVTTVVVVTTT",
     umi_rev_pattern: str = "AAABBBBAABBBBAABBBBAABBBBAABBAAA",
     reference: dict[str, str] | None = None,
+    with_adapters: bool = False,
     **reference_kwargs,
 ) -> SimulatedLibrary:
     """Generate a full library with ground truth.
 
     Reads are shuffled and emitted in random +/- orientation; headers carry
     ``mol=<i>`` ground-truth tags (ignored by the pipeline, used by tests).
+
+    ``with_adapters=True`` emits UNTRIMMED reads: the full UVP forward
+    primer at the 5' end and revcomp of the reverse primer at the 3' end
+    (what the basecaller hands to ``dorado trim``) — requires the pipeline's
+    primer-trim stage. The default emits pre-trimmed reads with the short
+    leftover flanks.
     """
     rng = np.random.default_rng(seed)
     ref = reference if reference is not None else make_reference(
@@ -188,9 +202,11 @@ def simulate_library(
                 num_reads=int(rng.integers(reads_per_molecule[0], reads_per_molecule[1] + 1)),
             )
             molecules.append(mol)
+    left = PRIMER_FWD if with_adapters else LEFT_FLANK
+    right = revcomp(PRIMER_REV) if with_adapters else RIGHT_FLANK
     for mi, mol in enumerate(molecules):
         template = (
-            LEFT_FLANK + mol.umi_fwd + ref[mol.region] + mol.umi_rev + RIGHT_FLANK
+            left + mol.umi_fwd + ref[mol.region] + mol.umi_rev + right
         )
         for ri in range(mol.num_reads):
             seq, qual = mutate(rng, template, sub_rate, ins_rate, del_rate)

@@ -106,30 +106,68 @@ def polish_draft(
     return out, int(kept.size)
 
 
-def make_pipeline_polisher(params, band_width: int = 128):
-    """Adapter for ``stages.polish_clusters_stage(polisher=...)``.
+def _device_polish_batch(params, sub, lens, drafts, dlens, band_width):
+    """(C,S,W) cluster tile -> (pred (C,W), confidence (C,W), depth (C,W)).
 
-    Returns f(subread_codes, subread_lens, consensus, consensus_len) ->
-    (polished, polished_len): re-pileups the subreads against the vote
-    consensus and applies the RNN — the medaka pass of the pipeline
-    (medaka_polish.py:95-144 analogue).
+    One pileup + one RNN dispatch for the whole tile — the batched medaka
+    pass (medaka_polish.py:95-144 analogue, without the per-cluster
+    subprocess fan-out the reference schedules around).
     """
-    import jax.numpy as jnp_
-
     from ont_tcrconsensus_tpu.ops import consensus as consensus_mod
     from ont_tcrconsensus_tpu.ops import pileup as pileup_mod
 
-    def polish(codes, lens, cons, clen):
-        if clen == 0:
-            return cons, clen
-        base_at, ins_cnt, _, _ = pileup_mod.pileup_columns(
-            codes, lens, jnp_.asarray(cons), jnp_.int32(clen),
-            np.zeros(codes.shape[0], np.int32),
-            band_width=band_width, out_len=cons.shape[0],
+    base_at, ins_cnt, _, _ = pileup_mod.pileup_columns_batch(
+        sub, lens, drafts, dlens, band_width=band_width, out_len=drafts.shape[1]
+    )
+    feats = jax.vmap(consensus_mod.pileup_features)(base_at, ins_cnt, drafts)
+    logits = apply_logits(params, feats)  # (C, W, 5)
+    probs = jax.nn.softmax(logits, axis=-1)
+    pred = jnp.argmax(logits, axis=-1).astype(jnp.uint8)
+    conf = jnp.max(probs, axis=-1)
+    depth = jnp.sum(base_at != pileup_mod.UNCOVERED, axis=1)
+    return pred, conf, depth
+
+
+_device_polish_batch_jit = jax.jit(
+    _device_polish_batch, static_argnames=("band_width",)
+)
+
+
+def make_pipeline_polisher(params, band_width: int = 128,
+                           min_confidence: float = 0.9):
+    """Adapter for ``stages.polish_clusters_stage(polisher=...)``.
+
+    Returns f(sub (C,S,W), lens (C,S), drafts (C,W), dlens (C,)) ->
+    (polished (C,W), polished_lens (C,)): one device dispatch per cluster
+    tile; the tiny splice of predicted deletions happens host-side.
+    """
+    from ont_tcrconsensus_tpu.ops.encode import PAD_CODE
+
+    def polish(sub, lens, drafts, dlens):
+        pred, conf, depth = _device_polish_batch_jit(
+            params, jnp.asarray(sub), jnp.asarray(lens),
+            jnp.asarray(drafts), jnp.asarray(dlens), band_width,
         )
-        feats = np.asarray(consensus_mod.pileup_features(base_at, ins_cnt, cons))
-        depth = (np.asarray(base_at) != pileup_mod.UNCOVERED).sum(axis=0)
-        return polish_draft(params, feats, cons, clen, depth=depth)
+        pred = np.asarray(pred)
+        conf = np.asarray(conf)
+        depth = np.asarray(depth)
+        drafts = np.asarray(drafts)
+        dlens = np.asarray(dlens)
+        C, W = drafts.shape
+        pos = np.arange(W)
+        out = np.full_like(drafts, PAD_CODE)
+        out_lens = np.zeros_like(dlens)
+        in_draft = pos[None, :] < dlens[:, None]
+        apply = in_draft & (depth > 0) & (conf >= min_confidence)
+        base = np.where(apply, pred, drafts)
+        keep = in_draft & ~(apply & (pred == 4))
+        for c in range(C):
+            if dlens[c] == 0:
+                continue
+            kept = base[c][keep[c]].astype(np.uint8)
+            out[c, : kept.size] = kept
+            out_lens[c] = kept.size
+        return out, out_lens
 
     return polish
 

@@ -47,6 +47,7 @@ CODE_TO_MASK = np.array([1, 2, 4, 8, 15, 0], dtype=np.uint8)
 COMPLEMENT = np.array([T, G, C, A, N_CODE, PAD_CODE], dtype=np.uint8)
 
 _DECODE = np.array(list("ACGTN-"), dtype="U1")
+_DECODE_ASCII = np.frombuffer(b"ACGTN-", dtype=np.uint8)
 
 
 def encode_seq(seq: str) -> np.ndarray:
@@ -64,6 +65,27 @@ def decode_seq(codes: np.ndarray, length: int | None = None) -> str:
     if length is not None:
         codes = codes[:length]
     return "".join(_DECODE[np.asarray(codes, dtype=np.int64)])
+
+
+def decode_batch(codes: np.ndarray, lengths: np.ndarray) -> list[str]:
+    """(B, W) dense codes + (B,) lengths -> list of strings.
+
+    One vectorized LUT pass + per-row ``tobytes().decode`` — ~50x faster than
+    per-character joins, which matters on the artifact-write path.
+    """
+    ascii_rows = _DECODE_ASCII[np.ascontiguousarray(codes)]
+    lens = np.asarray(lengths)
+    return [
+        ascii_rows[i, : lens[i]].tobytes().decode("ascii")
+        for i in range(ascii_rows.shape[0])
+    ]
+
+
+def decode_phred_batch(quals: np.ndarray, lengths: np.ndarray) -> list[str]:
+    """(B, W) uint8 Phred batch + lengths -> Phred-33 quality strings."""
+    q = np.ascontiguousarray(np.asarray(quals, dtype=np.uint8) + 33)
+    lens = np.asarray(lengths)
+    return [q[i, : lens[i]].tobytes().decode("ascii") for i in range(q.shape[0])]
 
 
 def revcomp_codes(codes: np.ndarray, length: int | None = None) -> np.ndarray:

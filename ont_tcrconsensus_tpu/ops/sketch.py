@@ -53,8 +53,13 @@ def kmer_profile(
             (packed.astype(jnp.uint32) * jnp.uint32(_HASH_MULT)) % jnp.uint32(dim)
         ).astype(jnp.int32)
     bucket = jnp.where(ok, bucket, dim)  # overflow bucket, dropped below
-    one_hot = jax.nn.one_hot(bucket, dim + 1, dtype=jnp.float32)
-    return jnp.sum(one_hot, axis=1)[:, :dim]
+    # scatter-add instead of a (B, L-k+1, dim+1) one-hot materialization:
+    # at B=1024, L=4096, dim=4096 the one-hot is a ~64-billion-element
+    # intermediate; the scatter writes L-k+1 updates per row.
+    rows = jnp.broadcast_to(jnp.arange(B, dtype=jnp.int32)[:, None], bucket.shape)
+    out = jnp.zeros((B, dim + 1), jnp.float32)
+    out = out.at[rows, bucket].add(1.0)
+    return out[:, :dim]
 
 
 @functools.partial(jax.jit, static_argnames=("top_k",))

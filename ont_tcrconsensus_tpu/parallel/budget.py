@@ -1,0 +1,92 @@
+"""HBM-budgeted batch sizing — the medaka memory model, TPU edition.
+
+The reference schedules its dominant stage with a hand-fit linear memory
+model: ``mem_GB/cluster = 0.0143 * max_subreads + 0.0286`` plus a task
+overhead, split into <=20 GB batches and quantized into 75 bins so Ray can
+bucket the requests (/root/reference/ont_tcr_consensus/medaka_polish.py:
+11-92). The TPU equivalent sizes DEVICE BATCHES from array-shape arithmetic
+against the chip's real HBM capacity: one knob (``hbm_budget_gb``), batch
+sizes derived, OOM-free by construction.
+
+Footprint models (bytes, from the shapes the kernels actually allocate):
+
+- fused read pass (:mod:`..pipeline.assign`): per read of padded width W —
+  ~10 u8 planes of W (codes/quals/oriented/revcomp/shifted/masks), two
+  k-mer-profile scatters of (dim+1) f32, top_k banded-SW output clusters
+  of 6 int32 bands, and the (R,) candidate score rows.
+- polish cluster tile (:mod:`..ops.pileup`): per cluster of S subreads x
+  width W — the dominant term is the traceback planes (tdir+fjump), two u8
+  planes of (W rows x band) per subread, plus the base/ins pileup columns.
+
+Powers of two keep XLA compile caches small (one program per size).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+DEFAULT_HBM_GB = 12.0  # conservative v5e chip budget when detection fails
+
+
+def detect_hbm_gb() -> float:
+    """Per-chip HBM capacity; falls back to a conservative default."""
+    try:
+        import jax
+
+        dev = jax.local_devices()[0]
+        stats = dev.memory_stats()
+        if stats and "bytes_limit" in stats:
+            return stats["bytes_limit"] / 1e9
+    except Exception:
+        pass
+    return DEFAULT_HBM_GB
+
+
+def _pow2_floor(n: int, lo: int, hi: int) -> int:
+    p = lo
+    while p * 2 <= min(n, hi):
+        p *= 2
+    return max(p, lo)
+
+
+@dataclasses.dataclass
+class BudgetModel:
+    """Derives device batch sizes from one HBM budget.
+
+    ``working_fraction`` reserves headroom for XLA scratch, fusion
+    temporaries and double-buffered transfers.
+    """
+
+    hbm_gb: float
+    working_fraction: float = 0.25
+
+    @property
+    def budget_bytes(self) -> int:
+        return int(self.hbm_gb * 1e9 * self.working_fraction)
+
+    def read_bytes(self, width: int, profile_dim: int = 4096,
+                   top_k: int = 2, band_width: int = 256,
+                   num_refs: int = 1024) -> int:
+        planes = 10 * width                      # u8 code/qual/mask planes
+        profiles = 2 * 4 * (profile_dim + 1)     # fwd+rev scatter targets
+        scores = 2 * 4 * num_refs                # both-strand candidate rows
+        sw_out = top_k * 6 * 4 * band_width      # per-pair band outputs
+        return planes + profiles + scores + sw_out
+
+    def read_batch(self, width: int, profile_dim: int = 4096,
+                   top_k: int = 2, band_width: int = 256,
+                   num_refs: int = 1024) -> int:
+        per = self.read_bytes(width, profile_dim, top_k, band_width, num_refs)
+        return _pow2_floor(self.budget_bytes // per, 128, 16384)
+
+    def cluster_bytes(self, s_bucket: int, width: int,
+                      band_width: int = 128) -> int:
+        traceback = 2 * s_bucket * width * band_width  # tdir+fjump u8 planes
+        pileup = s_bucket * width * (1 + 4 + 1)        # base_at/ins_cnt/ins_base
+        votes = 2 * width * 4 * 8                      # vote stacks (int32)
+        return traceback + pileup + votes
+
+    def cluster_batch(self, s_bucket: int, width: int,
+                      band_width: int = 128) -> int:
+        per = self.cluster_bytes(s_bucket, width, band_width)
+        return _pow2_floor(self.budget_bytes // per, 1, 64)

@@ -1,12 +1,20 @@
 """CLI entry point: ``tcr-consensus-tpu <run_config.json>``.
 
 Mirrors the reference console script ``tcr_consensus``
-(/root/reference/pyproject.toml:46-47, tcr_consensus.py:33-36).
+(/root/reference/pyproject.toml:46-47, tcr_consensus.py:33-36). On a
+multi-host TPU pod slice, set ``TCR_CONSENSUS_DISTRIBUTED=1`` (the launcher
+script does this when the TPU runtime reports multiple workers) and run the
+same command on every host: ``jax.distributed`` discovers the pod topology
+and ``mesh_shape`` then spans the global device set — the multi-host
+shard-by-barcode configuration of SURVEY §2.3. DCN carries only XLA
+collectives; bulk reads stay host-local, mirroring the reference's
+filesystem data plane.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 
@@ -16,6 +24,13 @@ def main(argv: list[str] | None = None) -> int:
     )
     parser.add_argument("json_config_file", help="Path to analysis run JSON config file")
     args = parser.parse_args(argv)
+
+    if os.environ.get("TCR_CONSENSUS_DISTRIBUTED"):
+        import jax
+
+        # TPU pod runtime provides coordinator/process env; this is a no-op
+        # single-host and makes jax.devices() global across hosts otherwise
+        jax.distributed.initialize()
 
     from ont_tcrconsensus_tpu.pipeline.run import run_pipeline
 

@@ -26,12 +26,18 @@ from typing import Any
 _COMPAT_IGNORED = {
     "dorado_excutable",  # sic — reference's own spelling (run_config.json:30)
     "dorado_executable",
-    "nanopore_tcr_seq_primers_fasta",
     "medaka_model",
     "medaka_memory_gb_per_umi_cluster",
     "medaka_memory_gb_task_overhead",
     "max_cap_medaka_memory_gb",
 }
+
+# packaged primer set (dorado trim analogue input; the reference ships the
+# same four GSP/UVP primers at ont_tcr_consensus/primers/primers.fasta)
+DEFAULT_PRIMERS_FASTA = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "primers", "primers.fasta",
+)
 
 
 @dataclasses.dataclass
@@ -46,7 +52,13 @@ class RunConfig:
     only_run_reference_self_homology: bool = False
     delete_tmp_files: bool = True
 
-    # --- read preprocessing (EE filter; reference preprocessing.py:104-159) ---
+    # --- read preprocessing (trim + EE filter; preprocessing.py:7-159) ---
+    trim_primers: bool = True
+    nanopore_tcr_seq_primers_fasta: str | None = None  # None -> packaged set
+    primer_max_dist_frac: float = 0.15   # edits allowed per primer length
+    #   (0.15 separates true primer hits, ~0-3 edits at ONT error rates,
+    #   from adapter-remnant-anchored partial matches at ~10+ edits)
+    trim_window: int = 150               # nt searched at each read end
     dorado_trim_subsample_fastq: int | None = None
     minimal_length: int = 1470
     max_ee_rate_base: float = 0.07
@@ -84,16 +96,32 @@ class RunConfig:
 
     # --- TPU execution (new; no reference analogue) ---
     backend: str = "jax"              # "jax" | "numpy" (debug)
-    read_batch_size: int = 2048       # reads per device batch
+    hbm_budget_gb: float | None = None  # None -> detect chip HBM (the one
+    #   scheduler knob; batch sizes derive from it — parallel/budget.py,
+    #   replacing the reference's medaka memory model)
+    read_batch_size: int | None = None  # None -> derived from hbm_budget_gb
+    cluster_batch_size: int | None = None  # None -> derived per tile shape
     umi_batch_size: int = 4096        # UMIs per distance-matrix tile
     max_read_length: int = 4096       # padded read width cap
     mesh_shape: dict[str, int] | None = None  # e.g. {"data": 8}
     resume: bool = False              # stage-level resume from manifest
+    write_intermediate_fastas: bool = True  # per-stage fasta artifacts
+    error_profile_sample: int = 1000  # reads/library profiled for the cs-tag
+    #   error artifact (qc/error_profile.py); 0 disables
 
     @property
     def cluster_identity(self) -> float:
         """Region-cluster threshold; reference tcr_consensus.py:68."""
         return 1.0 - self.max_ee_rate_base
+
+    def primer_sequences(self) -> list[str]:
+        """Primer set for the trim stage; [] when trimming is disabled."""
+        if not self.trim_primers:
+            return []
+        from ont_tcrconsensus_tpu.io import fastx
+
+        path = self.nanopore_tcr_seq_primers_fasta or DEFAULT_PRIMERS_FASTA
+        return [rec.sequence for rec in fastx.read_fastx(path)]
 
     def validate(self) -> None:
         if not self.reference_file:
@@ -122,12 +150,38 @@ class RunConfig:
         for name in (
             "minimal_length", "max_pattern_dist", "min_umi_length",
             "max_umi_length", "min_reads_per_cluster", "max_reads_per_cluster",
-            "read_batch_size", "umi_batch_size", "max_read_length",
+            "umi_batch_size", "max_read_length",
             "max_softclip_5_end", "max_softclip_3_end",
         ):
             v = getattr(self, name)
             if not isinstance(v, int) or v < 0:
                 raise ValueError(f"{name}={v!r} must be a non-negative int")
+        if not isinstance(self.error_profile_sample, int) or self.error_profile_sample < 0:
+            raise ValueError(
+                f"error_profile_sample={self.error_profile_sample!r} must be a "
+                "non-negative int"
+            )
+        for name in ("read_batch_size", "cluster_batch_size"):  # nullable int
+            v = getattr(self, name)
+            if v is not None and (not isinstance(v, int) or v <= 0):
+                raise ValueError(f"{name}={v!r} must be a positive int or null")
+        if self.hbm_budget_gb is not None and not (
+            isinstance(self.hbm_budget_gb, (int, float)) and self.hbm_budget_gb > 0
+        ):
+            raise ValueError(
+                f"hbm_budget_gb={self.hbm_budget_gb!r} must be a positive number or null"
+            )
+        if not (0.0 <= self.primer_max_dist_frac <= 1.0):
+            raise ValueError(
+                f"primer_max_dist_frac={self.primer_max_dist_frac} outside [0, 1]"
+            )
+        if not isinstance(self.trim_window, int) or self.trim_window <= 0:
+            raise ValueError(f"trim_window={self.trim_window!r} must be a positive int")
+        if self.trim_primers and self.nanopore_tcr_seq_primers_fasta:
+            if not os.path.exists(self.nanopore_tcr_seq_primers_fasta):
+                raise ValueError(
+                    f"primers fasta not found: {self.nanopore_tcr_seq_primers_fasta}"
+                )
         if self.min_umi_length > self.max_umi_length:
             raise ValueError("min_umi_length > max_umi_length")
         if self.min_reads_per_cluster > self.max_reads_per_cluster:

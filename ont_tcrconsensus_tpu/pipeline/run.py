@@ -5,14 +5,20 @@ mirroring the reference flow (/root/reference/ont_tcr_consensus/
 tcr_consensus.py:33-478):
 
   PHASE A (once):  reference self-homology -> region clusters + precision bar
-  PHASE B (per library): EE filter -> align + split by region cluster
-  round 1:         UMI extract -> cluster @0.93 -> subread select -> consensus
+  PHASE B (per library): fused device pass (primer trim -> EE filter ->
+                   align -> UMI locate) -> split by region cluster
+  round 1:         UMI cluster @0.93 -> subread select -> batched consensus
   round 2:         consensus align + blast-id filter -> split by region ->
-                   UMI extract -> cluster @0.97 -> select(min=1) -> counts CSV
+                   UMI cluster @0.97 -> select(min=1) -> counts CSV
 
 Unlike the reference (which refuses an existing output dir,
 tcr_consensus.py:84-86), stages record completion in a per-library manifest
 and ``resume=True`` skips completed libraries.
+
+Multi-chip: ``mesh_shape`` (e.g. ``{"data": 8}``) builds a
+:class:`jax.sharding.Mesh` and every fused-pass batch is sharded over the
+``data`` axis — the TPU equivalent of the reference's per-library/per-region
+Ray fan-out (tcr_consensus.py:141-167; SURVEY §2.3).
 """
 
 from __future__ import annotations
@@ -30,6 +36,7 @@ from ont_tcrconsensus_tpu.io import fastx, layout
 from ont_tcrconsensus_tpu.pipeline import stages
 from ont_tcrconsensus_tpu.pipeline.config import RunConfig
 from ont_tcrconsensus_tpu.qc import artifacts, umi_overlap
+from ont_tcrconsensus_tpu.qc.timing import StageTimer
 
 # fallback precision bar when no reference pair survives the homology filter
 # (the reference would crash there; see cluster/regions.py docstring)
@@ -44,6 +51,47 @@ def run_pipeline(config_path: str, polisher=None) -> dict[str, dict[str, int]]:
     """Run the full pipeline; returns {library: {region: count}}."""
     cfg = RunConfig.from_json(config_path)
     return run_with_config(cfg, polisher=polisher)
+
+
+def make_mesh_from_config(cfg: RunConfig):
+    """Build the data mesh named by ``cfg.mesh_shape`` (None -> no mesh)."""
+    if not cfg.mesh_shape:
+        return None
+    from ont_tcrconsensus_tpu.parallel import mesh as mesh_mod
+
+    mesh = mesh_mod.make_mesh(dict(cfg.mesh_shape))
+    if "data" not in mesh.axis_names:
+        raise ValueError(f"mesh_shape {cfg.mesh_shape} needs a 'data' axis")
+    n_data = dict(zip(mesh.axis_names, mesh.devices.shape))["data"]
+    if cfg.read_batch_size is not None and cfg.read_batch_size % n_data:
+        raise ValueError(
+            f"read_batch_size={cfg.read_batch_size} must divide by the "
+            f"data axis size {n_data}"
+        )
+    return mesh
+
+
+def resolve_batching(cfg: RunConfig, num_refs: int, mesh=None):
+    """(read_batch_size, BudgetModel) from the one HBM knob.
+
+    The budgeter (parallel/budget.py) replaces the reference's hand-fit
+    medaka memory model (medaka_polish.py:11-92); explicit config values
+    override the derived sizes. With a mesh, the global batch must divide
+    the data axis (each chip sees batch/n_data rows).
+    """
+    from ont_tcrconsensus_tpu.parallel import budget as budget_mod
+
+    budget = budget_mod.BudgetModel(
+        cfg.hbm_budget_gb if cfg.hbm_budget_gb is not None
+        else budget_mod.detect_hbm_gb()
+    )
+    read_batch = cfg.read_batch_size or budget.read_batch(
+        cfg.max_read_length, num_refs=max(num_refs, 1)
+    )
+    if mesh is not None:
+        n_data = dict(zip(mesh.axis_names, mesh.devices.shape))["data"]
+        read_batch = max(read_batch - read_batch % n_data, n_data)
+    return read_batch, budget
 
 
 def run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]:
@@ -91,6 +139,25 @@ def run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]:
         return {}
 
     panel = stages.ReferencePanel.build(reference, homology.region_cluster)
+    mesh = make_mesh_from_config(cfg)
+    if mesh is not None:
+        _log("Sharding device batches over mesh:", dict(cfg.mesh_shape))
+    read_batch, budget = resolve_batching(cfg, len(panel.names), mesh)
+    _log(f"Device batching: read_batch={read_batch}, "
+         f"hbm_budget={budget.hbm_gb:.1f} GB")
+    engine = stages.AssignEngine(
+        panel, cfg.umi_fwd, cfg.umi_rev,
+        primers=cfg.primer_sequences(),
+        primer_max_dist_frac=cfg.primer_max_dist_frac,
+        a5=cfg.max_softclip_5_end, a3=cfg.max_softclip_3_end,
+        trim_window=cfg.trim_window, mesh=mesh,
+    )
+    # round 2 aligns already-trimmed consensus sequences: no primer search
+    engine_notrim = stages.AssignEngine(
+        panel, cfg.umi_fwd, cfg.umi_rev, primers=[],
+        a5=cfg.max_softclip_5_end, a3=cfg.max_softclip_3_end, mesh=mesh,
+    )
+
     fastq_list = sorted(glob.glob(os.path.join(cfg.fastq_pass_dir, "barcode*", "*fastq*")))
     if not fastq_list:
         fastq_list = sorted(
@@ -108,16 +175,20 @@ def run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]:
             results[lay.library] = _read_counts_csv(counts_csv)
             continue
         results[lay.library] = _run_library(
-            fastq, lay, cfg, panel, blast_id_threshold, overlap_consensus, polisher
+            fastq, lay, cfg, panel, engine, engine_notrim,
+            blast_id_threshold, overlap_consensus, polisher,
+            read_batch, budget,
         )
     _log("Done running all barcodes!")
     return results
 
 
-def _run_library(fastq, lay, cfg, panel, blast_id_threshold, overlap_consensus,
-                 polisher) -> dict[str, int]:
+def _run_library(fastq, lay, cfg, panel, engine, engine_notrim,
+                 blast_id_threshold, overlap_consensus, polisher,
+                 read_batch, budget) -> dict[str, int]:
     library = lay.library
     merged_path = os.path.join(lay.fasta, "merged_consensus.fasta")
+    timer = StageTimer()
 
     # stage-level resume: a completed round 1 is reloaded from its artifact
     if cfg.resume and lay.stage_done("round1_consensus") and os.path.exists(merged_path):
@@ -125,37 +196,59 @@ def _run_library(fastq, lay, cfg, panel, blast_id_threshold, overlap_consensus,
         merged_consensus = [
             (rec.header, rec.sequence) for rec in fastx.read_fastx(merged_path)
         ]
-        return _run_round2(lay, cfg, panel, blast_id_threshold, overlap_consensus,
-                           merged_consensus)
+        return _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
+                           overlap_consensus, merged_consensus, timer,
+                           read_batch, budget)
 
-    # PHASE B: EE filter (preprocessing.py:104-159)
-    _log("Preprocessing with expected-error filtering:", library)
-    filtered = list(stages.ee_filter_stage(
-        fastx.read_fastx(fastq),
-        max_ee_rate=cfg.max_ee_rate_base,
-        min_len=cfg.minimal_length,
-        batch_size=cfg.read_batch_size,
-        max_read_length=cfg.max_read_length,
-        subsample=cfg.dorado_trim_subsample_fastq,
-    ))
+    # PHASE B + round-1 assignment: ONE fused device pass per batch
+    # (trim -> EE -> align -> UMI locate; preprocessing.py:7-159 +
+    # minimap2_align.py:76-155 + region_split.py:219-333 + extract_umis.py)
+    _log("Preprocessing, aligning and UMI-tagging nanopore reads:", library)
+    with timer.stage("round1_fused_assign"):
+        store, astats = stages.run_assign(
+            fastq, engine,
+            max_ee_rate=cfg.max_ee_rate_base,
+            min_len=cfg.minimal_length,
+            minimal_region_overlap=cfg.minimal_region_overlap,
+            max_softclip_5_end=cfg.max_softclip_5_end,
+            max_softclip_3_end=cfg.max_softclip_3_end,
+            batch_size=read_batch,
+            max_read_length=cfg.max_read_length,
+            subsample=cfg.dorado_trim_subsample_fastq,
+        )
     with open(os.path.join(lay.logs, "ee_filter.log"), "w") as fh:
-        fh.write(f"reads passing EE/length filter: {len(filtered)}\n")
-
-    # align + split by region cluster (round 1)
-    _log("Aligning nanopore reads:", library)
-    aligned, astats = stages.assign_reads(
-        filtered, panel,
-        minimal_region_overlap=cfg.minimal_region_overlap,
-        max_softclip_5_end=cfg.max_softclip_5_end,
-        max_softclip_3_end=cfg.max_softclip_3_end,
-        batch_size=cfg.read_batch_size,
-        max_read_length=cfg.max_read_length,
-    )
+        fh.write(
+            f"reads passing EE/length filter: {astats.n_total - astats.n_ee_fail}\n"
+        )
+        fh.write(f"reads with primer trim: {astats.n_trimmed}\n")
     _write_align_log(astats, os.path.join(lay.logs, f"{library}_region_cluster_split.log"))
-    groups = stages.split_by_region_cluster(aligned, panel)
-    stages.write_region_fastas(groups, lay.region_cluster_fasta, "region_cluster")
+    artifacts.write_fastq_stats_log(
+        astats, os.path.join(lay.logs, f"{library}_fastq_stats.log")
+    )
+    artifacts.write_flagstat_log(
+        astats, os.path.join(lay.logs, f"{library}_flagstat.log")
+    )
+
+    if cfg.error_profile_sample:
+        from ont_tcrconsensus_tpu.qc import error_profile
+
+        with timer.stage("round1_error_profile"):
+            counters = error_profile.profile_store(
+                store, panel, sample_size=cfg.error_profile_sample
+            )
+            error_profile.write_error_profile_log(
+                *counters,
+                os.path.join(lay.logs, f"{library}_align_error_profile.log"),
+            )
+
+    groups = stages.group_by_region_cluster(store, panel)
+    if cfg.write_intermediate_fastas:
+        with timer.stage("write_region_fastas"):
+            stages.write_region_fastas(
+                groups, store, lay.region_cluster_fasta, "region_cluster"
+            )
     artifacts.write_region_split_log(
-        astats, groups, panel.names,
+        astats, groups, store, panel.names,
         {n: len(s) for n, s in panel.seqs.items()},
         regions_mod.NEGATIVE_CONTROL_SUFFIXES,
         os.path.join(
@@ -163,29 +256,31 @@ def _run_library(fastq, lay, cfg, panel, blast_id_threshold, overlap_consensus,
         ),
     )
 
-    # round 1: UMI extract / cluster / select / consensus, per region cluster
+    # round 1: UMI cluster / select / consensus, per region cluster
     merged_consensus: list[tuple[str, str]] = []
     for cluster_key in sorted(groups):
         group_name = f"region_cluster{cluster_key}"
-        reads = [(r.name, r.seq, r.strand) for r in groups[cluster_key]]
-        umis = stages.extract_umis_stage(
-            reads, cfg.umi_fwd, cfg.umi_rev, cfg.max_pattern_dist,
-            cfg.max_softclip_5_end, cfg.max_softclip_3_end,
-        )
+        with timer.stage("round1_umi_records"):
+            umis = stages.build_umi_records(
+                store, groups[cluster_key], cfg.max_pattern_dist
+            )
         if not umis:
             continue
-        stages.write_umi_fasta(
-            umis, os.path.join(lay.umi_fasta, f"{group_name}_detected_umis.fasta")
-        )
-        selected, stat_rows = stages.cluster_and_select(
-            umis,
-            identity=cfg.vsearch_identity,
-            min_umi_length=cfg.min_umi_length,
-            max_umi_length=cfg.max_umi_length,
-            min_reads_per_cluster=cfg.min_reads_per_cluster,
-            max_reads_per_cluster=cfg.max_reads_per_cluster,
-            balance_strands=cfg.balance_strands,
-        )
+        if cfg.write_intermediate_fastas:
+            stages.write_umi_fasta(
+                umis, store,
+                os.path.join(lay.umi_fasta, f"{group_name}_detected_umis.fasta"),
+            )
+        with timer.stage("round1_umi_cluster"):
+            selected, stat_rows = stages.cluster_and_select(
+                umis,
+                identity=cfg.vsearch_identity,
+                min_umi_length=cfg.min_umi_length,
+                max_umi_length=cfg.max_umi_length,
+                min_reads_per_cluster=cfg.min_reads_per_cluster,
+                max_reads_per_cluster=cfg.max_reads_per_cluster,
+                balance_strands=cfg.balance_strands,
+            )
         cdir = os.path.join(lay.clustering, group_name)
         os.makedirs(cdir, exist_ok=True)
         stages.write_cluster_stats_tsv(
@@ -194,37 +289,44 @@ def _run_library(fastq, lay, cfg, panel, blast_id_threshold, overlap_consensus,
         if not selected:
             continue
         _log("Polishing clusters:", library, group_name, f"({len(selected)} clusters)")
-        merged_consensus.extend(stages.polish_clusters_stage(
-            selected, group_name,
-            max_read_length=cfg.max_read_length,
-            polisher=polisher,
-        ))
+        with timer.stage("round1_polish"):
+            merged_consensus.extend(stages.polish_clusters_stage(
+                selected, group_name, store,
+                max_read_length=cfg.max_read_length,
+                polisher=polisher,
+                budget=budget,
+                cluster_batch=cfg.cluster_batch_size,
+            ))
 
     fastx.write_fasta(merged_path, merged_consensus)
     lay.mark_stage_done("round1_consensus")
-    return _run_round2(lay, cfg, panel, blast_id_threshold, overlap_consensus,
-                       merged_consensus)
+    return _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
+                       overlap_consensus, merged_consensus, timer,
+                       read_batch, budget)
 
 
-def _run_round2(lay, cfg, panel, blast_id_threshold, overlap_consensus,
-                merged_consensus) -> dict[str, int]:
+def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
+                overlap_consensus, merged_consensus, timer,
+                read_batch, budget) -> dict[str, int]:
     library = lay.library
 
     # round 2: consensus align + blast-id filter + split by exact region
     _log("Aligning unique molecule consensus TCR sequences:", library)
     cons_records = [fastx.FastxRecord(h, "", s) for h, s in merged_consensus]
     qc_rows: list[dict] = []
-    cons_aligned, cstats = stages.assign_reads(
-        cons_records, panel,
-        minimal_region_overlap=overlap_consensus,
-        max_softclip_5_end=cfg.max_softclip_5_end,
-        max_softclip_3_end=cfg.max_softclip_3_end,
-        batch_size=cfg.read_batch_size,
-        top_k=4,
-        max_read_length=cfg.max_read_length,
-        blast_id_threshold=blast_id_threshold,
-        collect_qc=qc_rows,
-    )
+    with timer.stage("round2_fused_assign"):
+        cons_store, cstats = stages.run_assign(
+            cons_records, engine_notrim,
+            max_ee_rate=1.0,  # no quality data on consensus sequences
+            min_len=1,
+            minimal_region_overlap=overlap_consensus,
+            max_softclip_5_end=cfg.max_softclip_5_end,
+            max_softclip_3_end=cfg.max_softclip_3_end,
+            batch_size=read_batch,
+            max_read_length=cfg.max_read_length,
+            blast_id_threshold=blast_id_threshold,
+            collect_qc=qc_rows,
+        )
     artifacts.write_consensus_filter_artifacts(
         qc_rows,
         {n: len(s) for n, s in panel.seqs.items()},
@@ -233,46 +335,72 @@ def _run_round2(lay, cfg, panel, blast_id_threshold, overlap_consensus,
         blast_id_threshold=blast_id_threshold,
         minimal_region_overlap=overlap_consensus,
     )
-    region_groups = stages.split_by_region(cons_aligned, panel)
-    stages.write_region_fastas(region_groups, lay.region_fasta, "region_")
+    artifacts.write_flagstat_log(
+        cstats, os.path.join(lay.logs, "merged_consensus_flagstat.log")
+    )
+    if cfg.error_profile_sample:
+        from ont_tcrconsensus_tpu.qc import error_profile
 
-    # round 2: UMI extract + dedup clustering at consensus identity
+        with timer.stage("round2_error_profile"):
+            counters = error_profile.profile_store(
+                cons_store, panel, sample_size=cfg.error_profile_sample
+            )
+            error_profile.write_error_profile_log(
+                *counters,
+                os.path.join(lay.logs, "merged_consensus_align_error_profile.log"),
+            )
+    region_groups = stages.group_by_region(cons_store, panel)
+    if cfg.write_intermediate_fastas:
+        stages.write_region_fastas(region_groups, cons_store, lay.region_fasta, "region_")
+
+    # round 2: UMI dedup clustering at consensus identity
     region_counts: dict[str, int] = {}
     region_cluster_umis: dict[str, list[str]] = {}
-    for region, reads_in_region in sorted(region_groups.items()):
-        reads = [(r.name, r.seq, r.strand) for r in reads_in_region]
-        umis = stages.extract_umis_stage(
-            reads, cfg.umi_fwd, cfg.umi_rev, cfg.max_pattern_dist,
-            cfg.max_softclip_5_end, cfg.max_softclip_3_end,
-        )
+    for region, parts in sorted(region_groups.items()):
+        with timer.stage("round2_umi_records"):
+            umis = stages.build_umi_records(cons_store, parts, cfg.max_pattern_dist)
         if not umis:
             continue
-        stages.write_umi_fasta(
-            umis, os.path.join(lay.consensus_umi_fasta, f"region_{region}_detected_umis.fasta")
-        )
-        selected, stat_rows = stages.cluster_and_select(
-            umis,
-            identity=cfg.vsearch_identity_consensus,
-            min_umi_length=cfg.min_umi_length,
-            max_umi_length=cfg.max_umi_length,
-            min_reads_per_cluster=1,
-            max_reads_per_cluster=cfg.max_reads_per_cluster,
-            balance_strands=False,
-        )
+        if cfg.write_intermediate_fastas:
+            stages.write_umi_fasta(
+                umis, cons_store,
+                os.path.join(
+                    lay.consensus_umi_fasta, f"region_{region}_detected_umis.fasta"
+                ),
+            )
+        with timer.stage("round2_umi_cluster"):
+            selected, stat_rows = stages.cluster_and_select(
+                umis,
+                identity=cfg.vsearch_identity_consensus,
+                min_umi_length=cfg.min_umi_length,
+                max_umi_length=cfg.max_umi_length,
+                min_reads_per_cluster=1,
+                max_reads_per_cluster=cfg.max_reads_per_cluster,
+                balance_strands=False,
+            )
         rdir = os.path.join(lay.clustering_consensus, f"region_{region}")
         os.makedirs(rdir, exist_ok=True)
         stages.write_cluster_stats_tsv(
             stat_rows, os.path.join(rdir, "vsearch_cluster_stats.tsv")
         )
         # smolecule parity: one entry per written member, named by cluster
-        smolecule = os.path.join(rdir, "smolecule_clusters.fa")
-        entries = [
-            (str(cl.cluster_id), m.seq) for cl in selected for m in cl.members
-        ]
-        fastx.write_fasta(smolecule, entries)
-        # the reference counts smolecule headers (count.py:9-20): the written
-        # members, capped by the selection math — not the cluster count
-        region_counts[region] = len(entries)
+        # (parse_umi_clusters.py:104-116)
+        if cfg.write_intermediate_fastas:
+            smolecule = os.path.join(rdir, "smolecule_clusters.fa")
+            entries = [
+                (str(cl.cluster_id),
+                 cons_store.blocks[m.block].decode_one(m.row))
+                for cl in selected for m in cl.members
+            ]
+            fastx.write_fasta(smolecule, entries)
+        # Count = round-2 CLUSTERS (unique molecules). Documented divergence:
+        # the reference greps smolecule headers (count.py:9-20), i.e. written
+        # members — identical whenever round 1 yields one cluster per
+        # molecule, but it double-counts a molecule whose round-1 UMI split
+        # produced two consensus even after its own round-2 dedup merged
+        # them into one cluster. Counting clusters is the molecule-accurate
+        # reading of "per-TCR UMI counts" (reference README.md:2).
+        region_counts[region] = len(selected)
         region_cluster_umis[region] = [cl.members[0].combined for cl in selected]
 
     stages.write_counts_csv(region_counts, lay.counts)
@@ -281,6 +409,7 @@ def _run_round2(lay, cfg, panel, blast_id_threshold, overlap_consensus,
         umi_overlap.count_overlapping_umis(
             region_cluster_umis, lay.logs, cfg.overlapping_umi_edit_threshold
         )
+    timer.write_tsv(os.path.join(lay.logs, "stage_timing.tsv"))
     lay.mark_stage_done("counts")
 
     if cfg.delete_tmp_files:
@@ -296,6 +425,8 @@ def _write_align_log(stats: stages.AlignStats, path: str) -> None:
     with open(path, "w") as fh:
         fh.write(f"Total # primary alignments: {stats.n_aligned}\n")
         fh.write(f"n_total: {stats.n_total}\n")
+        fh.write(f"n_ee_fail: {stats.n_ee_fail}\n")
+        fh.write(f"n_trimmed: {stats.n_trimmed}\n")
         fh.write(f"n_short: {stats.n_short}\n")
         fh.write(f"n_long: {stats.n_long}\n")
         fh.write(f"n_pass: {stats.n_pass}\n")

@@ -1,10 +1,12 @@
-"""Pipeline stages: device-batched equivalents of the reference's Ray tasks.
+"""Pipeline stages over the columnar read store.
 
 Each function is one stage of the 14-stage reference pipeline
-(/root/reference/ont_tcr_consensus/tcr_consensus.py:33-478), operating on
-padded device batches instead of "Ray task -> subprocess -> files". Stage
-contracts (inputs, filters, artifact layouts) mirror the reference; the
-compute underneath is the kernel library (:mod:`..ops`).
+(/root/reference/ont_tcr_consensus/tcr_consensus.py:33-478). The read-level
+hot path (trim/filter/align/UMI-locate) is the fused device pass in
+:mod:`.assign`; this module holds the host-side stages that operate on its
+columnar survivors: grouping, UMI record assembly, clustering + subread
+selection, batched consensus polish, and counting. Strings materialize only
+at artifact boundaries.
 """
 
 from __future__ import annotations
@@ -12,282 +14,29 @@ from __future__ import annotations
 import dataclasses
 import os
 from collections import defaultdict
-from collections.abc import Iterable, Iterator
 
 import numpy as np
 
 from ont_tcrconsensus_tpu.cluster import umi as umi_mod
 from ont_tcrconsensus_tpu.io import bucketing, fastx
 from ont_tcrconsensus_tpu.ops import consensus as consensus_mod
-from ont_tcrconsensus_tpu.ops import ee_filter, encode, fuzzy_match, sketch, sw_pallas
+from ont_tcrconsensus_tpu.ops import encode
+from ont_tcrconsensus_tpu.pipeline.assign import (  # noqa: F401  (re-exported)
+    AlignStats,
+    AssignEngine,
+    ReadStore,
+    ReferencePanel,
+    run_assign,
+)
 
 # ---------------------------------------------------------------------------
-# reference panel
-
-
-@dataclasses.dataclass
-class ReferencePanel:
-    """Encoded reference regions + sketch profiles, built once per run."""
-
-    names: list[str]
-    seqs: dict[str, str]
-    codes: np.ndarray          # (R, W) uint8
-    lens: np.ndarray           # (R,) int32
-    profiles: np.ndarray       # (R, dim) float32
-    region_cluster: dict[str, int]
-
-    @classmethod
-    def build(cls, reference: dict[str, str], region_cluster: dict[str, int],
-              pad_multiple: int = 128) -> "ReferencePanel":
-        names = list(reference)
-        max_len = max(len(s) for s in reference.values())
-        codes, lens = encode.encode_batch([reference[n] for n in names], pad_to=max_len,
-                                          multiple=pad_multiple)
-        profiles = np.asarray(sketch.kmer_profile(codes, lens))
-        return cls(names=names, seqs=dict(reference), codes=codes, lens=lens,
-                   profiles=profiles, region_cluster=dict(region_cluster))
-
-    def region_len(self, idx: int) -> int:
-        return int(self.lens[idx])
-
-
-# ---------------------------------------------------------------------------
-# stage: expected-error filtering (vsearch --fastq_filter equivalent,
-# preprocessing.py:104-159)
-
-
-def ee_filter_stage(
-    records: Iterable[fastx.FastxRecord],
-    max_ee_rate: float,
-    min_len: int,
-    batch_size: int = 2048,
-    max_read_length: int = 4096,
-    subsample: int | None = None,
-) -> Iterator[fastx.FastxRecord]:
-    """Stream records through the device EE filter; yields survivors.
-
-    ``subsample`` mirrors ``dorado trim --max-reads`` head-subsampling
-    (preprocessing.py:41-57): only the first N records are considered.
-    """
-    taken = 0
-
-    def limited():
-        nonlocal taken
-        for rec in records:
-            if subsample is not None and taken >= subsample:
-                return
-            taken += 1
-            yield rec
-
-    for batch in bucketing.batch_reads(
-        limited(), batch_size=batch_size,
-        widths=tuple(w for w in bucketing.DEFAULT_WIDTHS if w <= max_read_length),
-        min_len=1,
-    ):
-        keep = np.asarray(
-            ee_filter.ee_rate_mask(batch.quals, batch.lengths, max_ee_rate, min_len)
-        ).copy()
-        keep &= batch.valid
-        kept_ids = set(np.where(keep)[0].tolist())
-        for i in sorted(kept_ids):
-            name, _, comment = batch.ids[i].partition(" ")
-            seq = encode.decode_seq(batch.codes[i], int(batch.lengths[i]))
-            qual = "".join(chr(33 + q) for q in batch.quals[i, : batch.lengths[i]])
-            yield fastx.FastxRecord(name, comment, seq, qual)
-
-
-# ---------------------------------------------------------------------------
-# stage: alignment + region assignment (minimap2_ont_align +
-# filter_and_split_reads_by_region_cluster, minimap2_align.py:76-155 +
-# region_split.py:219-333)
-
-
-@dataclasses.dataclass
-class AlignedRead:
-    name: str
-    seq: str               # original orientation, as sequenced
-    strand: str            # '+' or '-'
-    region_idx: int
-    blast_id: float
-    ref_start: int
-    ref_end: int
-    read_start: int        # in aligned (oriented) coordinates
-    read_end: int
-    score: int
-
-
-@dataclasses.dataclass
-class AlignStats:
-    n_total: int = 0
-    n_aligned: int = 0     # primary-mapped equivalents
-    n_short: int = 0
-    n_long: int = 0
-    n_pass: int = 0
-
-
-def assign_reads(
-    records: Iterable[fastx.FastxRecord],
-    panel: ReferencePanel,
-    minimal_region_overlap: float,
-    max_softclip_5_end: int,
-    max_softclip_3_end: int,
-    batch_size: int = 1024,
-    top_k: int = 2,
-    band_width: int = 256,
-    min_score: int = 100,
-    max_read_length: int = 4096,
-    blast_id_threshold: float | None = None,
-    collect_qc: list | None = None,
-) -> tuple[list[AlignedRead], AlignStats]:
-    """Align every read to its best reference region; apply region filters.
-
-    A read's "primary alignment" is the best banded-SW score over the
-    ``top_k`` sketch candidates on the detected strand. Filters mirror
-    region_split.py:261-269 (ref overlap, read-length window) and — when
-    ``blast_id_threshold`` is given (round 2) — minimap2_align.py:209-245.
-    """
-    stats = AlignStats()
-    out: list[AlignedRead] = []
-    widths = tuple(w for w in bucketing.DEFAULT_WIDTHS if w <= max_read_length)
-    for batch in bucketing.batch_reads(
-        records, batch_size=batch_size, widths=widths, with_quals=False, min_len=1
-    ):
-        nv = batch.num_valid
-        stats.n_total += nv
-        codes = batch.codes[:nv]
-        lens = batch.lengths[:nv]
-        cand_idx, _, is_rev = sketch.candidates_both_strands(
-            codes, lens, panel.profiles, top_k=top_k
-        )
-        cand_idx = np.asarray(cand_idx)
-        is_rev = np.asarray(is_rev)
-        # orient reads for alignment
-        oriented = np.asarray(sketch.revcomp_batch(codes, lens))
-        oriented = np.where(is_rev[:, None], oriented, codes)
-        # align against each candidate; keep the best score
-        best = None
-        for c in range(top_k):
-            ridx = cand_idx[:, c]
-            offs = sketch.diag_offset(lens, panel.lens[ridx]).astype(np.int32)
-            res = sw_pallas.align_banded_auto(
-                oriented, lens, panel.codes[ridx], panel.lens[ridx], offs,
-                band_width=band_width,
-            )
-            res_np = {
-                "score": np.asarray(res.score), "ridx": ridx,
-                "ref_start": np.asarray(res.ref_start), "ref_end": np.asarray(res.ref_end),
-                "read_start": np.asarray(res.read_start), "read_end": np.asarray(res.read_end),
-                "blast_id": np.asarray(res.blast_id),
-            }
-            if best is None:
-                best = res_np
-            else:
-                better = res_np["score"] > best["score"]
-                for k in best:
-                    best[k] = np.where(better, res_np[k], best[k])
-        for i in range(nv):
-            if best["score"][i] < min_score:
-                continue
-            stats.n_aligned += 1
-            ridx = int(best["ridx"][i])
-            rlen = panel.region_len(ridx)
-            ref_span = int(best["ref_end"][i]) - int(best["ref_start"][i])
-            qc = {
-                "name": batch.ids[i].partition(" ")[0],
-                "region": panel.names[ridx],
-                "ref_span": ref_span,
-                "read_len": int(lens[i]),
-                "region_len": rlen,
-                "blast_id": float(best["blast_id"][i]),
-            }
-            if ref_span < rlen * minimal_region_overlap:
-                stats.n_short += 1
-                if collect_qc is not None:
-                    qc["status"] = "short"
-                    qc["nt_short"] = rlen * minimal_region_overlap - ref_span
-                    collect_qc.append(qc)
-                continue
-            max_len = rlen * (2 - minimal_region_overlap) + (
-                max_softclip_5_end + max_softclip_3_end
-            )
-            if int(lens[i]) > max_len:
-                stats.n_long += 1
-                if collect_qc is not None:
-                    qc["status"] = "long"
-                    qc["nt_long"] = int(lens[i]) - max_len
-                    collect_qc.append(qc)
-                continue
-            if blast_id_threshold is not None and not (
-                float(best["blast_id"][i]) > blast_id_threshold
-            ):
-                if collect_qc is not None:
-                    qc["status"] = "low_blast_id"
-                    collect_qc.append(qc)
-                continue
-            stats.n_pass += 1
-            if collect_qc is not None:
-                qc["status"] = "pass"
-                collect_qc.append(qc)
-            name, _, _ = batch.ids[i].partition(" ")
-            out.append(AlignedRead(
-                name=name,
-                seq=encode.decode_seq(codes[i], int(lens[i])),
-                strand="-" if is_rev[i] else "+",
-                region_idx=ridx,
-                blast_id=float(best["blast_id"][i]),
-                ref_start=int(best["ref_start"][i]),
-                ref_end=int(best["ref_end"][i]),
-                read_start=int(best["read_start"][i]),
-                read_end=int(best["read_end"][i]),
-                score=int(best["score"][i]),
-            ))
-    return out, stats
-
-
-def split_by_region_cluster(
-    aligned: list[AlignedRead], panel: ReferencePanel
-) -> dict[int, list[AlignedRead]]:
-    """Round-1 grouping: reads binned per region *cluster*
-    (region_split.py:271-280)."""
-    groups: dict[int, list[AlignedRead]] = defaultdict(list)
-    for r in aligned:
-        cluster = panel.region_cluster[panel.names[r.region_idx]]
-        groups[cluster].append(r)
-    return dict(groups)
-
-
-def split_by_region(
-    aligned: list[AlignedRead], panel: ReferencePanel
-) -> dict[str, list[AlignedRead]]:
-    """Round-2 grouping: per exact region (region_split.py:336-435)."""
-    groups: dict[str, list[AlignedRead]] = defaultdict(list)
-    for r in aligned:
-        groups[panel.names[r.region_idx]].append(r)
-    return dict(groups)
-
-
-def write_region_fastas(
-    groups: dict, out_dir: str, prefix: str
-) -> dict[str, str]:
-    """Write per-group fastas in the reference's format: original-orientation
-    sequence, header ``<name>;strand=<+/->`` (region_split.py:273-280)."""
-    paths = {}
-    for key, reads in sorted(groups.items(), key=lambda kv: str(kv[0])):
-        fname = f"{prefix}{key}.fasta"
-        path = os.path.join(out_dir, fname)
-        fastx.write_fasta(
-            path, ((f"{r.name};strand={r.strand}", r.seq) for r in reads)
-        )
-        paths[str(key)] = path
-    return paths
-
-
-# ---------------------------------------------------------------------------
-# stage: UMI extraction (extract_umis.py:189-267)
+# stage: UMI record assembly (extract_umis.py:189-267)
 
 
 @dataclasses.dataclass
 class UmiRecord:
+    """One read's extracted UMI pair + a (block, row) handle into the store."""
+
     name: str
     strand: str
     umi_fwd_dist: int
@@ -295,80 +44,108 @@ class UmiRecord:
     umi_fwd_seq: str
     umi_rev_seq: str
     combined: str          # canonical (molecule) orientation
-    seq: str               # full read, original orientation
+    block: int
+    row: int
 
-    def header(self) -> str:
-        """7-field header parity (extract_umis.py:174-181)."""
+    def header(self, store: ReadStore) -> str:
+        """7-field header parity (extract_umis.py:174-181); the full read is
+        smuggled in ``seq=`` exactly like the reference's UMI fasta."""
+        seq = store.blocks[self.block].decode_one(self.row)
         return (
             f"{self.name};strand={self.strand};umi_fwd_dist={self.umi_fwd_dist};"
             f"umi_rev_dist={self.umi_rev_dist};umi_fwd_seq={self.umi_fwd_seq};"
-            f"umi_rev_seq={self.umi_rev_seq};seq={self.seq}"
+            f"umi_rev_seq={self.umi_rev_seq};seq={seq}"
         )
 
 
-def extract_umis_stage(
-    reads: list[tuple[str, str, str]],
-    umi_fwd: str,
-    umi_rev: str,
+def build_umi_records(
+    store: ReadStore,
+    parts: list[tuple[int, np.ndarray]],
     max_pattern_dist: int,
-    adapter_length_5_end: int,
-    adapter_length_3_end: int,
-    batch_size: int = 4096,
 ) -> list[UmiRecord]:
-    """Find both degenerate UMIs in each read's adapter windows.
+    """Assemble UMI records for one read group from the fused-pass fields.
 
-    Args:
-      reads: (name, seq_original_orientation, strand) triples.
-
-    The 5' window is searched with ``umi_fwd`` and the 3' window with
-    ``umi_rev`` regardless of strand — the two patterns are reverse
-    complements of each other, so '-' reads match symmetrically
-    (extract_umis.py:221-245). The combined UMI is canonicalized:
-    '+' -> fwd+rev, '-' -> revcomp(rev)+revcomp(fwd)
-    (combine_umis_fasta, extract_umis.py:140-151).
+    The 5' window was searched with ``umi_fwd`` and the 3' window with
+    ``umi_rev`` regardless of strand — the patterns are reverse complements,
+    so '-' reads match symmetrically (extract_umis.py:221-245). Combined UMI
+    canonicalization: '+' -> fwd+rev, '-' -> revcomp(rev)+revcomp(fwd)
+    (extract_umis.py:140-151). Reads where either pattern exceeds
+    ``max_pattern_dist`` are dropped, mirroring the edlib k gate.
     """
-    fwd_mask = encode.encode_mask(umi_fwd)
-    rev_mask = encode.encode_mask(umi_rev)
     out: list[UmiRecord] = []
-    win_pad = max(adapter_length_5_end, adapter_length_3_end)
-
-    for start in range(0, len(reads), batch_size):
-        chunk = reads[start : start + batch_size]
-        win5 = [seq[:adapter_length_5_end] for _, seq, _ in chunk]
-        win3 = [seq[-adapter_length_3_end:] for _, seq, _ in chunk]
-        # pad the final chunk to the full batch size (static shapes)
-        n_pad = batch_size - len(chunk)
-        if n_pad:
-            win5 += [""] * n_pad
-            win3 += [""] * n_pad
-        w5, l5 = encode.encode_mask_batch(win5, pad_to=win_pad)
-        w3, l3 = encode.encode_mask_batch(win3, pad_to=win_pad)
-        d5, s5, e5 = (np.asarray(x) for x in fuzzy_match.fuzzy_find(fwd_mask, w5, l5))
-        d3, s3, e3 = (np.asarray(x) for x in fuzzy_match.fuzzy_find(rev_mask, w3, l3))
-        for i, (name, seq, strand) in enumerate(chunk):
-            if d5[i] > max_pattern_dist or d3[i] > max_pattern_dist:
+    for bi, rows in parts:
+        blk = store.blocks[bi]
+        u = blk.umi
+        ok = (u["d5"][rows] <= max_pattern_dist) & (u["d3"][rows] <= max_pattern_dist)
+        ok &= (u["e5"][rows] > u["s5"][rows]) & (u["e3"][rows] > u["s3"][rows])
+        ascii_rows = encode._DECODE_ASCII[blk.codes[rows]]
+        for k, r in enumerate(rows):
+            if not ok[k]:
                 continue
-            u5 = win5[i][s5[i] : e5[i]]
-            u3 = win3[i][s3[i] : e3[i]]
-            if not u5 or not u3:
-                continue
+            s5, e5 = int(u["s5"][r]), int(u["e5"][r])
+            a3 = int(u["start3"][r])
+            s3, e3 = a3 + int(u["s3"][r]), a3 + int(u["e3"][r])
+            u5 = ascii_rows[k, s5:e5].tobytes().decode("ascii")
+            u3 = ascii_rows[k, s3:e3].tobytes().decode("ascii")
+            strand = "-" if blk.is_rev[r] else "+"
             if strand == "+":
                 combined = u5 + u3
             else:
                 combined = encode.revcomp_str(u3) + encode.revcomp_str(u5)
             out.append(UmiRecord(
-                name=name, strand=strand,
-                umi_fwd_dist=int(d5[i]), umi_rev_dist=int(d3[i]),
+                name=blk.names[r], strand=strand,
+                umi_fwd_dist=int(u["d5"][r]), umi_rev_dist=int(u["d3"][r]),
                 umi_fwd_seq=u5, umi_rev_seq=u3,
-                combined=combined, seq=seq,
+                combined=combined, block=bi, row=int(r),
             ))
     return out
 
 
-def write_umi_fasta(records: list[UmiRecord], path: str) -> int:
+def write_umi_fasta(records: list[UmiRecord], store: ReadStore, path: str) -> int:
     """The 'UMI fasta': combined UMI as sequence, full read smuggled in the
     header (extract_umis.py:154-186)."""
-    return fastx.write_fasta(path, ((r.header(), r.combined) for r in records))
+    return fastx.write_fasta(
+        path, ((r.header(store), r.combined) for r in records)
+    )
+
+
+# ---------------------------------------------------------------------------
+# stage: region grouping + per-group fasta artifacts (region_split.py)
+
+
+def group_by_region_cluster(store: ReadStore, panel: ReferencePanel):
+    """Round-1 grouping: reads binned per region *cluster*
+    (region_split.py:271-280). Returns {cluster_id: [(block, rows)]}."""
+    return store.group_rows_by(panel.cluster_of_region)
+
+
+def group_by_region(store: ReadStore, panel: ReferencePanel):
+    """Round-2 grouping: per exact region (region_split.py:336-435).
+    Returns {region_name: [(block, rows)]}."""
+    idx_groups = store.group_rows_by(np.arange(len(panel.names), dtype=np.int32))
+    return {panel.names[k]: v for k, v in idx_groups.items()}
+
+
+def write_region_fastas(
+    groups: dict, store: ReadStore, out_dir: str, prefix: str
+) -> dict[str, str]:
+    """Per-group fastas in the reference's format: original-orientation
+    sequence, header ``<name>;strand=<+/->`` (region_split.py:273-280)."""
+    paths = {}
+    for key, parts in sorted(groups.items(), key=lambda kv: str(kv[0])):
+        path = os.path.join(out_dir, f"{prefix}{key}.fasta")
+
+        def rows_iter(parts=parts):
+            for bi, rows in parts:
+                blk = store.blocks[bi]
+                seqs = blk.decode(rows)
+                for k, r in enumerate(rows):
+                    strand = "-" if blk.is_rev[r] else "+"
+                    yield f"{blk.names[r]};strand={strand}", seqs[k]
+
+        fastx.write_fasta(path, rows_iter())
+        paths[str(key)] = path
+    return paths
 
 
 # ---------------------------------------------------------------------------
@@ -475,45 +252,55 @@ def write_cluster_stats_tsv(stat_rows: list[dict], path: str) -> None:
 def polish_clusters_stage(
     selected: list[SelectedCluster],
     group_name: str,
+    store: ReadStore,
     max_read_length: int = 4096,
     rounds: int = 4,
     band_width: int = 128,
     polisher=None,
-    cluster_batch: int = 16,
+    cluster_batch: int | None = None,
+    budget=None,
 ) -> list[tuple[str, str]]:
     """Consensus per selected cluster; returns (header, sequence) pairs.
 
     Headers follow the reference's rewrite
     ``<group>_<clusterN>_<n_subreads>`` (medaka_polish.py:146-180).
-    Subreads enter in canonical (+) orientation — strand is known from
-    alignment, so no internal re-orientation pass is needed.
+    Subreads are gathered from the columnar store and flipped to canonical
+    (+) orientation (strand is known from alignment — unlike medaka, no
+    internal re-orientation pass).
 
     Static-shape discipline: clusters are grouped by (subread-count bucket,
     width bucket) and processed in batches of ``cluster_batch`` through one
-    device dispatch per round (``consensus_clusters_batch``), so XLA
-    compiles one kernel per shape bucket instead of one per cluster.
+    device dispatch per round (``consensus_clusters_batch``); the optional
+    ``polisher`` is called ONCE per chunk on the whole (C, S, W) tile
+    (medaka_polish.py:95-144 analogue, batched across clusters).
     Padding rows have length 0: they score 0 and cast no votes.
     """
     prepared: dict[tuple[int, int], list[tuple[SelectedCluster, np.ndarray, np.ndarray]]] = (
         defaultdict(list)
     )
     for cl in selected:
-        seqs = [
-            m.seq if m.strand == "+" else encode.revcomp_str(m.seq)
-            for m in cl.members
-        ]
+        rows_codes = []
+        max_len = 0
+        for m in cl.members:
+            blk = store.blocks[m.block]
+            ln = int(blk.lens[m.row])
+            c = blk.codes[m.row, :ln]
+            if m.strand == "-":
+                c = encode.revcomp_codes(c)
+            rows_codes.append(c)
+            max_len = max(max_len, ln)
         # one lane-width of growth slack above the longest subread
-        need = max(len(s) for s in seqs) + 128
+        need = max_len + 128
         width = min(
             max_read_length,
             next((w for w in bucketing.DEFAULT_WIDTHS if w >= need), max_read_length),
         )
-        codes, lens = encode.encode_batch(seqs, pad_to=width, multiple=128)
+        codes, lens = encode.pad_batch(rows_codes, pad_to=width, multiple=128)
         s_bucket = 1
-        while s_bucket < len(seqs):
+        while s_bucket < len(rows_codes):
             s_bucket *= 2
-        if s_bucket > len(seqs):
-            pad_rows = s_bucket - len(seqs)
+        if s_bucket > len(rows_codes):
+            pad_rows = s_bucket - len(rows_codes)
             codes = np.concatenate(
                 [codes, np.full((pad_rows, codes.shape[1]), encode.PAD_CODE, np.uint8)]
             )
@@ -522,13 +309,21 @@ def polish_clusters_stage(
 
     out: list[tuple[str, str]] = []
     for (s_bucket, width), items in sorted(prepared.items()):
-        for start in range(0, len(items), cluster_batch):
-            chunk = items[start : start + cluster_batch]
+        # cluster-tile batch from the HBM budget (the medaka memory-model
+        # analogue, parallel/budget.py) unless explicitly overridden
+        if cluster_batch is not None:
+            cb = cluster_batch
+        elif budget is not None:
+            cb = budget.cluster_batch(s_bucket, width, band_width)
+        else:
+            cb = 16
+        for start in range(0, len(items), cb):
+            chunk = items[start : start + cb]
             C = len(chunk)
             sub = np.stack([codes for _, codes, _ in chunk])
             lens = np.stack([ln for _, _, ln in chunk])
-            if C < cluster_batch:  # pad the cluster axis: stable compile shapes
-                pad = cluster_batch - C
+            if C < cb:  # pad the cluster axis: stable compile shapes
+                pad = cb - C
                 sub = np.concatenate(
                     [sub, np.full((pad, s_bucket, width), encode.PAD_CODE, np.uint8)]
                 )
@@ -536,14 +331,13 @@ def polish_clusters_stage(
             drafts, dlens = consensus_mod.consensus_clusters_batch(
                 sub, lens, rounds=rounds, band_width=band_width
             )
+            if polisher is not None:
+                drafts, dlens = polisher(sub, lens, drafts, dlens)
+            seqs = encode.decode_batch(drafts[:C], dlens[:C])
             for c in range(C):
                 cl = chunk[c][0]
-                cons, clen = drafts[c], int(dlens[c])
-                if polisher is not None:
-                    cons, clen = polisher(sub[c], lens[c], cons, clen)
-                seq = encode.decode_seq(cons, clen)
                 out.append(
-                    (f"{group_name}_cluster{cl.cluster_id}_{len(cl.members)}", seq)
+                    (f"{group_name}_cluster{cl.cluster_id}_{len(cl.members)}", seqs[c])
                 )
     out.sort(key=lambda kv: int(kv[0].rsplit("_cluster", 1)[1].split("_")[0]))
     return out

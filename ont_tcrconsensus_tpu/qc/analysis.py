@@ -55,6 +55,23 @@ def parse_quantile_95_blast_id_from_self_homology_log(log_path: str) -> float | 
     return None
 
 
+def parse_raw_nanopore_qual_from_fastq_stats(log_path: str) -> float | None:
+    """Mean raw-read quality from the fastq-stats artifact
+    (analysis.py:76-81 parses seqkit's AvgQual column; ours reads the
+    pre-filter row of logs/<library>_fastq_stats.log)."""
+    with open(log_path) as fh:
+        header = fh.readline().rstrip("\n").split("\t")
+        try:
+            qcol = header.index("avg_qual")
+        except ValueError:
+            return None
+        for line in fh:
+            parts = line.rstrip("\n").split("\t")
+            if parts and parts[0] == "post_trim_pre_filter":
+                return float(parts[qcol])
+    return None
+
+
 def read_counts_csv(path: str) -> dict[str, int]:
     out: dict[str, int] = {}
     with open(path) as fh:
@@ -308,26 +325,201 @@ def plot_umi_count_hist(counts: dict[str, int], out_path: str,
     _savefig(fig, out_path)
 
 
+_PLATE_ROWS = "ABCDEFGHIJKLMNOP"  # 384-well plate: 16 rows x 24 columns
+
+
+def parse_plate_well(region_name: str) -> tuple[int, int, int] | None:
+    """Region name -> (plate, row, col) for 384-well layouts.
+
+    The reference's TCR names embed plate + well as fields 1 and 2 of the
+    underscore-split name, e.g. ``TCR_3_B07_...`` -> plate 3, well B07
+    (analysis.py:921-926: ``ref.split("_")[1] + "_" + ref.split("_")[2]``).
+    Returns None when the name doesn't carry a parseable plate/well.
+    """
+    parts = region_name.split("_")
+    if len(parts) < 3:
+        return None
+    try:
+        plate = int(parts[1])
+    except ValueError:
+        return None
+    well = parts[2]
+    if not well or well[0].upper() not in _PLATE_ROWS:
+        return None
+    try:
+        col = int(well[1:])
+    except ValueError:
+        return None
+    if not (1 <= col <= 24):
+        return None
+    return plate, _PLATE_ROWS.index(well[0].upper()), col - 1
+
+
 def plot_plate_heatmap(counts: dict[str, int], out_path: str,
+                       reference_regions: set[str] | None = None,
                        rows: int = 16, cols: int = 24):
-    """384-well plate heatmap (analysis.py:914-993). Region names are mapped
-    to wells in sorted order when they don't carry explicit well ids."""
+    """384-well plate heatmaps (analysis.py:914-993).
+
+    Region names carrying plate/well ids (:func:`parse_plate_well`) get one
+    log-count heatmap per plate — wells absent from the reference are NaN,
+    present-but-undetected wells are 0 (the reference's semantics). Names
+    without well ids fall back to a single sorted-order grid.
+    ``out_path`` is used as-is for the fallback, and with ``_plate<N>``
+    inserted before the extension per real plate.
+    """
     import matplotlib
 
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
 
-    grid = np.full((rows, cols), np.nan)
-    for i, region in enumerate(sorted(counts)):
-        if i >= rows * cols:
-            break
-        grid[i // cols, i % cols] = counts[region]
-    fig, ax = plt.subplots(figsize=(10, 6))
-    im = ax.imshow(grid, aspect="auto", cmap="viridis")
-    fig.colorbar(im, ax=ax, label="UMI count")
-    ax.set_xlabel("plate column")
-    ax.set_ylabel("plate row")
-    _savefig(fig, out_path)
+    ref_names = reference_regions if reference_regions is not None else set(counts)
+    placed = {n: parse_plate_well(n) for n in ref_names}
+    parseable = {n: p for n, p in placed.items() if p is not None}
+
+    if not parseable:
+        grid = np.full((rows, cols), np.nan)
+        for i, region in enumerate(sorted(counts)):
+            if i >= rows * cols:
+                break
+            grid[i // cols, i % cols] = counts[region]
+        fig, ax = plt.subplots(figsize=(10, 6))
+        im = ax.imshow(grid, aspect="auto", cmap="viridis")
+        fig.colorbar(im, ax=ax, label="UMI count")
+        ax.set_xlabel("plate column")
+        ax.set_ylabel("plate row")
+        _savefig(fig, out_path)
+        return
+
+    plates = sorted({p[0] for p in parseable.values()})
+    root, ext = os.path.splitext(out_path)
+    for plate in plates:
+        grid = np.full((len(_PLATE_ROWS), 24), np.nan)
+        for name, (pl, i, j) in parseable.items():
+            if pl != plate:
+                continue
+            c = counts.get(name, 0)
+            grid[i, j] = np.log10(c) if c > 0 else 0.0
+        fig, ax = plt.subplots(figsize=(10, 7))
+        im = ax.matshow(grid, cmap="viridis")
+        ax.set_xticks(np.arange(24), labels=[str(c + 1) for c in range(24)], fontsize=7)
+        ax.set_yticks(np.arange(len(_PLATE_ROWS)), labels=list(_PLATE_ROWS), fontsize=7)
+        ax.set_title(f"Plate: {plate}", pad=20)
+        fig.colorbar(im, ax=ax, fraction=0.02, pad=0.03,
+                     label="Log transformed\nUMI count")
+        _savefig(fig, f"{root}_plate{plate}{ext}")
+
+
+# ---------------------------------------------------------------------------
+# V-gene composition plots (analysis.py:996-1232)
+
+
+def load_tcr_refs_csv(path: str,
+                      name_col: str = "name",
+                      trav_col: str = "TRAV_IMGT_allele_collapsed",
+                      trbv_col: str = "TRBV_IMGT_allele_collapsed") -> dict[str, dict[str, str]]:
+    """TCR metadata table: name -> {TRAV, TRBV} (the tcr_refs_df input of
+    the reference's V-gene plots)."""
+    import csv
+
+    out: dict[str, dict[str, str]] = {}
+    with open(path) as fh:
+        for row in csv.DictReader(fh):
+            name = row.get(name_col, "").strip()
+            if name:
+                out[name] = {
+                    "TRAV": row.get(trav_col, "").strip(),
+                    "TRBV": row.get(trbv_col, "").strip(),
+                }
+    return out
+
+
+def v_gene_fold_change(counts: dict[str, int], tcr_refs: dict[str, dict[str, str]],
+                       gene: str) -> dict[str, float]:
+    """Per-V-allele fold change of output fraction over input composition
+    (analysis.py:1010-1035): detected fraction of counts per allele divided
+    by the allele's share of the reference library."""
+    input_counts: dict[str, int] = defaultdict(int)
+    for meta in tcr_refs.values():
+        if meta.get(gene):
+            input_counts[meta[gene]] += 1
+    total_input = sum(input_counts.values())
+    out_frac: dict[str, float] = defaultdict(float)
+    total_counts = sum(counts.get(n, 0) for n in tcr_refs)
+    for name, meta in tcr_refs.items():
+        if meta.get(gene) and total_counts:
+            out_frac[meta[gene]] += counts.get(name, 0) / total_counts
+    return {
+        allele: (out_frac.get(allele, 0.0) / (n / total_input)) if total_input else 0.0
+        for allele, n in input_counts.items()
+    }
+
+
+def plot_v_gene_fold_change(counts: dict[str, int],
+                            tcr_refs: dict[str, dict[str, str]],
+                            out_dir: str, title: str | None = None):
+    """TRAV/TRBV fold-change-over-input barplots, median-normalized
+    (analysis.py:996-1117; same output filenames)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    for gene in ("TRAV", "TRBV"):
+        fc = v_gene_fold_change(counts, tcr_refs, gene)
+        if not fc:
+            continue
+        items = sorted(fc.items(), key=lambda kv: -kv[1])
+        vals = np.array([v for _, v in items], dtype=float)
+        med = np.median(vals[vals > 0]) if (vals > 0).any() else 1.0
+        fig, ax = plt.subplots(figsize=(max(6, len(items) / 4), 4))
+        ax.bar(np.arange(len(items)), vals / (med or 1.0),
+               edgecolor="black", linewidth=0.5, color="lightblue")
+        ax.axhline(1, color="red", linewidth=0.75)
+        ax.set_xticks(np.arange(len(items)))
+        ax.set_xticklabels([a for a, _ in items], rotation=90, fontsize=7)
+        ax.set_ylabel("Fold change over input\n(normalized to median)", fontsize=8)
+        if title:
+            ax.set_title(title, fontsize=8)
+        _savefig(fig, os.path.join(
+            out_dir, f"{gene}_fold_change_over_input_barplot.pdf"
+        ))
+
+
+def plot_v_gene_missing_tcrs(counts: dict[str, int],
+                             tcr_refs: dict[str, dict[str, str]],
+                             reference_regions: set[str],
+                             out_dir: str, title: str | None = None):
+    """V-allele distribution of undetected TCRs (analysis.py:1120-1232;
+    same output filenames). Returns the missing set."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    detected = {r for r, c in counts.items() if c > 0}
+    missing = sorted(set(reference_regions) & set(tcr_refs) - detected)
+    if not missing:
+        return []
+    for gene in ("TRAV", "TRBV"):
+        counter: dict[str, int] = defaultdict(int)
+        for name in missing:
+            allele = tcr_refs[name].get(gene)
+            if allele:
+                counter[allele] += 1
+        if not counter:
+            continue
+        items = sorted(counter.items(), key=lambda kv: -kv[1])
+        total = sum(v for _, v in items)
+        fig, ax = plt.subplots(figsize=(max(4, len(items) / 1.5), 4))
+        ax.bar(np.arange(len(items)), [v / total for _, v in items],
+               edgecolor="black", linewidth=0.5, color="lightblue")
+        ax.set_ylim(0, 1)
+        ax.set_xticks(np.arange(len(items)))
+        ax.set_xticklabels([a for a, _ in items], rotation=90, fontsize=7)
+        ax.set_ylabel("Fraction of missing TCRs", fontsize=8)
+        ax.set_title(f"{title or ''}, # missing TCRs = {total}", fontsize=8)
+        _savefig(fig, os.path.join(out_dir, f"{gene}_counter_missing_tcr_barplot.pdf"))
+    return missing
 
 
 # ---------------------------------------------------------------------------
@@ -339,6 +531,7 @@ def run_library_analysis(
     reference_regions: set[str],
     out_dir: str | None = None,
     log10_threshold: float | None = None,
+    tcr_refs: dict[str, dict[str, str]] | None = None,
 ) -> dict[str, float]:
     """Produce the per-library outs/ PDFs + results_summary.txt."""
     out_dir = out_dir or os.path.join(library_dir, "outs")
@@ -364,7 +557,11 @@ def run_library_analysis(
         plot_blast_id_vs_subreads_box(rows, os.path.join(out_dir, "blast_id_vs_subreads.pdf"))
     plot_umi_count_hist(counts, os.path.join(out_dir, "umi_count_hist.pdf"),
                         log10_threshold=log10_threshold)
-    plot_plate_heatmap(counts, os.path.join(out_dir, "plate_heatmap.pdf"))
+    plot_plate_heatmap(counts, os.path.join(out_dir, "plate_heatmap.pdf"),
+                       reference_regions=reference_regions)
+    if tcr_refs:
+        plot_v_gene_fold_change(counts, tcr_refs, out_dir)
+        plot_v_gene_missing_tcrs(counts, tcr_refs, reference_regions, out_dir)
     return write_results_summary(
         counts, reference_regions,
         os.path.join(out_dir, "results_summary.txt"),
@@ -372,30 +569,63 @@ def run_library_analysis(
     )
 
 
-def run_all_libraries(nano_dir: str, reference_regions: set[str],
-                      libraries_csv: str | None = None) -> dict[str, dict]:
+def read_libraries_csv(path: str) -> dict[str, dict]:
+    """libraries.csv (ref README.md:62-82): barcode -> {library_name,
+    ref_library_name, log_umi_counts_filter_threshold}."""
+    out: dict[str, dict] = {}
+    with open(path) as fh:
+        next(fh, None)
+        for line in fh:
+            parts = [p.strip() for p in line.split(",")]
+            if len(parts) < 4 or not parts[0]:
+                continue
+            try:
+                thr = float(parts[3])
+            except ValueError:
+                thr = None
+            out[parts[0]] = {
+                "library_name": parts[1],
+                "ref_library_name": parts[2],
+                "log_umi_counts_filter_threshold": thr,
+            }
+    return out
+
+
+def run_all_libraries(nano_dir: str, reference_regions,
+                      libraries_csv: str | None = None,
+                      tcr_refs_csv: str | None = None) -> dict[str, dict]:
     """Loop all per-library dirs (notebook cells 1+3).
 
-    ``libraries.csv`` (README.md:62-82) columns: barcode, library_name,
-    ref_library_name, log_umi_counts_filter_threshold. Absent -> every
-    library dir under nano_dir with no threshold."""
-    thresholds: dict[str, float | None] = {}
-    if libraries_csv and os.path.exists(libraries_csv):
-        with open(libraries_csv) as fh:
-            next(fh, None)
-            for line in fh:
-                parts = [p.strip() for p in line.split(",")]
-                if len(parts) >= 4 and parts[0]:
-                    try:
-                        thresholds[parts[0]] = float(parts[3])
-                    except ValueError:
-                        thresholds[parts[0]] = None
+    ``reference_regions`` is either one region-name set applied everywhere
+    or a dict keyed by ``ref_library_name`` — the per-library reference
+    mapping of ``libraries.csv`` (ref README.md:62-82: barcode,
+    library_name, ref_library_name, log_umi_counts_filter_threshold).
+    Output summaries are keyed ``<barcode>_<library_name>`` like the
+    notebook's outs/ directories. ``tcr_refs_csv`` enables the V-gene
+    composition plots."""
+    meta = read_libraries_csv(libraries_csv) if libraries_csv and os.path.exists(
+        libraries_csv
+    ) else {}
+    tcr_refs = load_tcr_refs_csv(tcr_refs_csv) if tcr_refs_csv and os.path.exists(
+        tcr_refs_csv
+    ) else None
     out = {}
     for name in sorted(os.listdir(nano_dir)):
         lib_dir = os.path.join(nano_dir, name)
         if not os.path.isdir(os.path.join(lib_dir, "counts")):
             continue
-        out[name] = run_library_analysis(
-            lib_dir, reference_regions, log10_threshold=thresholds.get(name)
+        m = meta.get(name, {})
+        regions = reference_regions
+        if isinstance(reference_regions, dict):
+            regions = reference_regions.get(
+                m.get("ref_library_name", ""), set()
+            ) or set().union(*reference_regions.values())
+        key = f"{name}_{m['library_name']}" if m.get("library_name") else name
+        out[key] = run_library_analysis(
+            lib_dir, regions,
+            out_dir=os.path.join(lib_dir, "outs") if not m.get("library_name")
+            else os.path.join(lib_dir, "outs", key),
+            log10_threshold=m.get("log_umi_counts_filter_threshold"),
+            tcr_refs=tcr_refs,
         )
     return out

@@ -102,18 +102,24 @@ def write_consensus_filter_artifacts(
 def write_region_split_log(
     stats,
     groups: dict,
+    store,
     panel_names: list[str],
     region_lengths: dict[str, int],
     negative_suffixes: tuple[str, ...],
     log_path: str,
 ) -> None:
     """Detection-fraction log of the round-1 split
-    (region_split.py:285-331)."""
-    per_group_counts = [len(v) for v in groups.values()]
+    (region_split.py:285-331). ``groups`` maps key -> [(block, rows)] into
+    the columnar ``store``."""
+    per_group_counts = [
+        sum(len(rows) for _, rows in parts) for parts in groups.values()
+    ]
     detected = set()
-    for reads in groups.values():
-        for r in reads:
-            detected.add(r.region_idx)
+    for parts in groups.values():
+        for bi, rows in parts:
+            detected.update(
+                int(i) for i in np.unique(store.blocks[bi].region_idx[rows])
+            )
     detected_names = {
         panel_names[i] for i in detected
         if not panel_names[i].endswith(negative_suffixes)
@@ -149,6 +155,44 @@ def write_region_split_log(
             "missing/non-detected regions from reference in initial non-polished "
             f"read alignments: {set(missing) if missing else 'set()'}\n"
         )
+
+
+def write_fastq_stats_log(stats, log_path: str) -> None:
+    """Before/after filter read stats — the seqkit-stat QC boundary artifact
+    (ref preprocessing.py:126-157 runs ``seqkit stat -a`` on the trimmed and
+    the filtered fastq; here both aggregates come from the fused pass)."""
+    with open(log_path, "w") as fh:
+        fh.write("stage\tnum_seqs\tsum_len\tmin_len\tavg_len\tmax_len\tavg_qual\n")
+        for name, ls in (("post_trim_pre_filter", stats.pre_filter),
+                         ("post_filter_pass", stats.post_filter)):
+            fh.write(
+                f"{name}\t{ls.n}\t{ls.sum_len}\t{ls.min_len}\t"
+                f"{ls.avg_len:.1f}\t{ls.max_len}\t{ls.avg_qual:.2f}\n"
+            )
+
+
+def write_flagstat_log(stats, log_path: str) -> None:
+    """Alignment summary — the ``samtools flagstat`` analogue
+    (ref minimap2_align.py:152-153). No BAM exists in this framework, so the
+    equivalent categories come from the fused pass counters."""
+    with open(log_path, "w") as fh:
+        fh.write(f"{stats.n_total} in total (reads entering alignment)\n")
+        fh.write(f"{stats.n_aligned} primary mapped "
+                 f"({_pct(stats.n_aligned, stats.n_total)} : score gate)\n")
+        n_unmapped = stats.n_total - stats.n_ee_fail - stats.n_aligned
+        fh.write(f"{stats.n_ee_fail} failed EE/length filter "
+                 f"({_pct(stats.n_ee_fail, stats.n_total)})\n")
+        fh.write(f"{max(n_unmapped, 0)} unmapped "
+                 f"({_pct(max(n_unmapped, 0), stats.n_total)})\n")
+        fh.write(f"{stats.n_short} mapped too short\n")
+        fh.write(f"{stats.n_long} read too long\n")
+        fh.write(f"{stats.n_low_blast} below blast-id threshold\n")
+        fh.write(f"{stats.n_pass} passing all filters "
+                 f"({_pct(stats.n_pass, stats.n_total)})\n")
+
+
+def _pct(a: int, b: int) -> str:
+    return f"{100.0 * a / b:.2f}%" if b else "N/A"
 
 
 def write_self_homology_log(stats: dict, log_path: str) -> None:

@@ -1,0 +1,215 @@
+"""cs-tag-style alignment difference profiling.
+
+The reference dumps, per alignment pass, the 40 most common minimap2 ``cs``
+difference strings with their region and blast-id breakdowns
+(/root/reference/ont_tcr_consensus/minimap2_align.py:21-37,140-150) — the
+pipeline's error-profile debugging artifact. This framework has no BAM/cs
+tags, so the equivalent difference strings are reconstructed host-side with
+a banded global alignment of each (sampled) read against the reference span
+it aligned to, emitted in cs syntax:
+
+    :N      run of N matches
+    *<r><q> substitution (reference base, query base)
+    +<seq>  insertion in the query
+    -<seq>  deletion from the reference
+
+Profiling is a QC path, not a hot path: it runs on a capped sample
+(default 1000 reads/library) with unit-cost edit alignment — the motif
+distribution, not base-perfect minimap2 score parity, is the artifact.
+"""
+
+from __future__ import annotations
+
+from collections import Counter, defaultdict
+
+import numpy as np
+
+_BASE = "acgtn"  # cs syntax is lowercase
+
+
+def banded_cs(query: np.ndarray, ref: np.ndarray, band: int = 96) -> str:
+    """cs difference string of a banded global alignment (unit costs).
+
+    Args:
+      query/ref: dense uint8 code arrays (no padding).
+    """
+    q = np.asarray(query, dtype=np.int16)
+    r = np.asarray(ref, dtype=np.int16)
+    n, m = len(q), len(r)
+    if n == 0:
+        return f"-{''.join(_BASE[c] for c in r)}" if m else ""
+    if m == 0:
+        return f"+{''.join(_BASE[c] for c in q)}"
+    # band around the length-interpolated diagonal
+    half = max(band // 2, abs(n - m) + 8)
+    BIG = 1 << 20
+    # rows: query positions 0..n; per row keep [lo, lo+W) of ref positions
+    W = 2 * half + 1
+    ptr = np.zeros((n + 1, W), dtype=np.uint8)  # 0 diag, 1 up(q-gap? see below), 2 left
+    prev = np.full(W, BIG, dtype=np.int64)
+    lo_of = [0] * (n + 1)
+
+    def row_lo(i: int) -> int:
+        center = round(i * m / n)
+        return max(0, min(center - half, m))
+
+    lo = row_lo(0)
+    lo_of[0] = lo
+    js = np.arange(lo, min(lo + W, m + 1))
+    prev[: len(js)] = js  # D[0][j] = j deletions
+    ptr[0, : len(js)] = 2
+
+    for i in range(1, n + 1):
+        nlo = row_lo(i)
+        lo_of[i] = nlo
+        cur = np.full(W, BIG, dtype=np.int64)
+        js = np.arange(nlo, min(nlo + W, m + 1))
+        k = len(js)
+        # shift the previous row into this row's band frame:
+        # aligned_prev[t] = prev value at ref position (nlo + t - 1)
+        shift = nlo - lo
+        aligned_prev = np.full(W + 1, BIG, dtype=np.int64)
+        t = np.arange(W + 1)
+        src = t + shift - 1
+        okm = (src >= 0) & (src < W)
+        aligned_prev[okm] = prev[src[okm]]
+        diag = aligned_prev[:W]                       # prev row, j-1
+        up = aligned_prev[1 : W + 1]                  # prev row, j
+        qi = q[i - 1]
+        jmask = js >= 1
+        rj = r[np.clip(js - 1, 0, m - 1)]
+        sub = np.where((rj == qi) & (qi < 4) & (rj < 4), 0, 1)
+        d = np.where(jmask[:k], diag[:k] + sub[:k], BIG)
+        u = up[:k] + 1
+        best = np.minimum(d, u)
+        p = np.where(u < d, 1, 0).astype(np.uint8)    # ties prefer diag
+        # left (ref-base deletion) chains collapse under unit cost:
+        # left[j] = min_{l<j}(best[l] + (j-l)) via a prefix-min cascade
+        idx = np.arange(k)
+        run_min = np.minimum.accumulate(best - idx)
+        left = run_min[np.maximum(idx - 1, 0)] + idx
+        left[0] = BIG
+        take_left = left < best
+        best = np.where(take_left, left, best)
+        p = np.where(take_left, 2, p).astype(np.uint8)
+        cur[:k] = best
+        ptr[i, :k] = p
+        prev = cur
+        lo = nlo
+
+    # traceback
+    i, jpos = n, m
+    ops: list[tuple[str, str]] = []  # (op, payload)
+    while i > 0 or jpos > 0:
+        lo = lo_of[i]
+        t = jpos - lo
+        if t < 0 or t >= W:
+            # fell off the band — bail with a conservative tail
+            break
+        p = ptr[i, t]
+        if i > 0 and jpos > 0 and p == 0:
+            qc, rc = q[i - 1], r[jpos - 1]
+            if qc == rc and qc < 4:
+                ops.append((":", ""))
+            else:
+                ops.append(("*", _BASE[rc] + _BASE[qc]))
+            i -= 1
+            jpos -= 1
+        elif i > 0 and p == 1:
+            ops.append(("+", _BASE[q[i - 1]]))
+            i -= 1
+        elif jpos > 0:
+            ops.append(("-", _BASE[r[jpos - 1]]))
+            jpos -= 1
+        else:
+            ops.append(("+", _BASE[q[i - 1]]))
+            i -= 1
+    ops.reverse()
+
+    # compress to cs syntax
+    out: list[str] = []
+    match_run = 0
+    k = 0
+    while k < len(ops):
+        op, payload = ops[k]
+        if op == ":":
+            match_run += 1
+            k += 1
+            continue
+        if match_run:
+            out.append(f":{match_run}")
+            match_run = 0
+        if op == "*":
+            out.append(f"*{payload}")
+            k += 1
+        else:  # run-collect insertions/deletions
+            run = [payload]
+            k += 1
+            while k < len(ops) and ops[k][0] == op:
+                run.append(ops[k][1])
+                k += 1
+            out.append(op + "".join(run))
+    if match_run:
+        out.append(f":{match_run}")
+    return "".join(out)
+
+
+def profile_store(store, panel, sample_size: int = 1000, seed: int = 0):
+    """cs-tag counters over a read-store sample.
+
+    Returns (tag_counter, tag->region counter, tag->blast_id counter) — the
+    same triple the reference builds from the BAM (minimap2_align.py:21-37).
+    Reads are profiled in their aligned orientation against the reference
+    span recorded by the fused pass.
+    """
+    from ont_tcrconsensus_tpu.ops import encode
+
+    handles = [
+        (bi, r) for bi, blk in enumerate(store.blocks) for r in range(blk.num_reads)
+    ]
+    rng = np.random.default_rng(seed)
+    if len(handles) > sample_size:
+        pick = rng.choice(len(handles), size=sample_size, replace=False)
+        handles = [handles[int(i)] for i in np.sort(pick)]
+
+    tag_counter: Counter = Counter()
+    tag_region: dict[str, Counter] = defaultdict(Counter)
+    tag_blast: dict[str, Counter] = defaultdict(Counter)
+    for bi, r in handles:
+        blk = store.blocks[bi]
+        ln = int(blk.lens[r])
+        qcodes = blk.codes[r, :ln]
+        if blk.is_rev[r]:
+            qcodes = encode.revcomp_codes(qcodes)
+        ridx = int(blk.region_idx[r])
+        rs, re = int(blk.ref_start[r]), int(blk.ref_end[r])
+        ref_codes = panel.codes[ridx, rs:re]
+        tag = banded_cs(qcodes, ref_codes)
+        tag_counter[tag] += 1
+        tag_region[tag][panel.names[ridx]] += 1
+        tag_blast[tag][round(float(blk.blast_id[r]), 6)] += 1
+    return tag_counter, tag_region, tag_blast
+
+
+def write_error_profile_log(
+    tag_counter: Counter, tag_region: dict, tag_blast: dict, log_path: str,
+    top_n: int = 40,
+) -> None:
+    """Reference log format (minimap2_align.py:140-150 sections)."""
+    top = tag_counter.most_common(top_n)
+    with open(log_path, "w") as fh:
+        fh.write(f"\nTop {top_n} most common cs tags:\n")
+        for tup in top:
+            fh.write(str(tup) + "\n")
+        fh.write(
+            f"\nTop 4 most common regions counted for each of the top {top_n} "
+            "most common cs tags:\n"
+        )
+        for tag, _ in top:
+            fh.write(f"{tag} {tag_region[tag].most_common(4)}\n")
+        fh.write(
+            f"\nTop 4 most common blast identities counted for each of the top {top_n} "
+            "most common cs tags:\n"
+        )
+        for tag, _ in top:
+            fh.write(f"{tag} {tag_blast[tag].most_common(4)}\n")

@@ -1,0 +1,45 @@
+"""Per-stage wall-clock accounting.
+
+The reference has no tracing at all (SURVEY §5: only stderr narration); this
+gives every pipeline run a ``stage_timing.tsv`` artifact so perf work has a
+breakdown to aim at, and ``bench.py`` can print where time goes.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+
+class StageTimer:
+    """Accumulates wall seconds per named stage (re-entrant across batches)."""
+
+    def __init__(self):
+        self.seconds: dict[str, float] = defaultdict(float)
+        self.calls: dict[str, int] = defaultdict(int)
+
+    @contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.seconds[name] += time.perf_counter() - t0
+            self.calls[name] += 1
+
+    def merge(self, other: "StageTimer") -> None:
+        for k, v in other.seconds.items():
+            self.seconds[k] += v
+            self.calls[k] += other.calls[k]
+
+    def summary(self) -> dict[str, float]:
+        return {k: round(v, 3) for k, v in sorted(
+            self.seconds.items(), key=lambda kv: -kv[1]
+        )}
+
+    def write_tsv(self, path: str) -> None:
+        with open(path, "w") as fh:
+            fh.write("stage\tseconds\tcalls\n")
+            for name, sec in sorted(self.seconds.items(), key=lambda kv: -kv[1]):
+                fh.write(f"{name}\t{sec:.3f}\t{self.calls[name]}\n")

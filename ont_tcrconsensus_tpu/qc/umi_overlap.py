@@ -28,11 +28,11 @@ def count_overlapping_umis(
     """
     tsv_path = os.path.join(logs_dir, "regions_w_overlapping_umis.tsv")
     err_path = os.path.join(logs_dir, "region_region_umi_comparison.stderr")
-    with open(tsv_path, "a") as fh:
-        fh.write("region_1\tregion_2\tumi_overlap_count\n")
 
     counters = {region: Counter(umis) for region, umis in region_umis.items()}
     out: list[bool] = []
+    tsv_rows: list[str] = []
+    warn_rows: list[str] = []
     for r1, r2 in itertools.combinations(region_umis, 2):
         c1, c2 = counters[r1], counters[r2]
         if len(c1) > len(c2):
@@ -41,13 +41,20 @@ def count_overlapping_umis(
         overlap = sum(n1 * c2.get(umi, 0) for umi, n1 in c1.items())
         multi_warn = any(c2.get(umi, 0) > 1 for umi in c1)
         if multi_warn:
-            with open(err_path, "a") as ferr:
-                ferr.write(
-                    f"WARNING: there are UMIs from {r1} that match more than 1 "
-                    f"UMI within {r2}\n"
-                )
+            warn_rows.append(
+                f"WARNING: there are UMIs from {r1} that match more than 1 "
+                f"UMI within {r2}\n"
+            )
         if overlap:
-            with open(tsv_path, "a") as fh:
-                fh.write(f"region_{r1}\tregion_{r2}\t{overlap}\n")
+            tsv_rows.append(f"region_{r1}\tregion_{r2}\t{overlap}\n")
         out.append(bool(overlap))
+
+    # single atomic write per call: reruns do not accumulate duplicate
+    # headers (unlike the reference's unguarded appends, extract_umis.py:325)
+    with open(tsv_path, "w") as fh:
+        fh.write("region_1\tregion_2\tumi_overlap_count\n")
+        fh.writelines(tsv_rows)
+    if warn_rows:
+        with open(err_path, "w") as ferr:
+            ferr.writelines(warn_rows)
     return out

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# TPU-VM launcher for the consensus pipeline — the deployment analogue of the
+# reference's SLURM wrapper (/root/reference/scripts/run_tcr_consensus_slurm.sh,
+# which sbatches 128 CPUs / 275 GB for tcr_consensus <run_config.json>).
+#
+# Single host (one TPU VM, 1-8 chips):
+#   ./run_tcr_consensus_tpu.sh run_config.json
+#
+# Multi-host TPU pod slice (e.g. v5e-16 = 2 hosts x 8 chips): run this script
+# on every host via gcloud's --worker=all fan-out; jax.distributed picks up
+# the pod topology from the TPU metadata and the pipeline shards its device
+# batches over the global mesh (shard-by-barcode across hosts is the
+# recommended mesh_shape, SURVEY §2.3):
+#   gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone="$ZONE" --worker=all \
+#     --command="cd $REPO_DIR && ./scripts/run_tcr_consensus_tpu.sh run_config.json"
+set -euo pipefail
+
+CONFIG="${1:?usage: run_tcr_consensus_tpu.sh <run_config.json>}"
+
+# multi-host: initialize jax.distributed before the pipeline builds its mesh
+# (no-op on a single host; TPU_WORKER_HOSTNAMES is set by the TPU runtime)
+export TCR_CONSENSUS_DISTRIBUTED="${TPU_WORKER_HOSTNAMES:+1}"
+
+LOG_DIR="$(dirname "$CONFIG")/logs"
+mkdir -p "$LOG_DIR"
+STAMP="$(date +%Y%m%d_%H%M%S)"
+
+exec tcr-consensus-tpu "$CONFIG" \
+  > "$LOG_DIR/tcr_consensus_tpu_${STAMP}.log" \
+  2> "$LOG_DIR/tcr_consensus_tpu_${STAMP}.err"

@@ -1,0 +1,49 @@
+"""HBM budgeter: derived batch sizes are monotone, bounded, OOM-safe math."""
+
+import numpy as np
+
+from ont_tcrconsensus_tpu.parallel.budget import BudgetModel, detect_hbm_gb
+
+
+def test_detect_returns_positive():
+    assert detect_hbm_gb() > 0
+
+
+def test_read_batch_monotone_in_budget():
+    small = BudgetModel(hbm_gb=2.0)
+    big = BudgetModel(hbm_gb=16.0)
+    assert big.read_batch(4096) >= small.read_batch(4096)
+
+
+def test_read_batch_monotone_in_width():
+    m = BudgetModel(hbm_gb=8.0)
+    assert m.read_batch(1024) >= m.read_batch(4096)
+
+
+def test_read_batch_power_of_two_and_bounded():
+    for gb in (0.5, 2.0, 8.0, 32.0, 1000.0):
+        b = BudgetModel(hbm_gb=gb).read_batch(4096)
+        assert 128 <= b <= 16384
+        assert (b & (b - 1)) == 0  # power of two
+
+
+def test_cluster_batch_respects_budget():
+    m = BudgetModel(hbm_gb=8.0)
+    for s in (4, 16, 64):
+        for w in (512, 2048, 4096):
+            cb = m.cluster_batch(s, w)
+            assert 1 <= cb <= 64
+            assert (cb & (cb - 1)) == 0
+            # the tile must actually fit the working budget
+            assert cb * m.cluster_bytes(s, w) <= m.budget_bytes or cb == 1
+
+
+def test_cluster_batch_shrinks_with_tile_size():
+    m = BudgetModel(hbm_gb=8.0)
+    assert m.cluster_batch(4, 512) >= m.cluster_batch(64, 4096)
+
+
+def test_fused_batch_fits_budget():
+    m = BudgetModel(hbm_gb=8.0)
+    b = m.read_batch(4096, num_refs=1024)
+    assert b * m.read_bytes(4096, num_refs=1024) <= m.budget_bytes

@@ -76,3 +76,34 @@ def test_large_roundtrip_speed(tmp_path):
     assert n_py == parsed.num_records
     # informational; tiny inputs may not show a gap
     print(f"native {native_dt * 1e3:.1f}ms vs python {py_dt * 1e3:.1f}ms")
+
+
+def test_batch_parsed_matches_batch_reads(tmp_path):
+    """The native columnar ingest path must produce byte-identical batches
+    to the pure-Python record path (same bucketing, order, padding)."""
+    import numpy as np
+
+    from ont_tcrconsensus_tpu.io import bucketing, fastx, native, simulator
+
+    lib = simulator.simulate_library(
+        seed=3, num_regions=2, molecules_per_region=(2, 3),
+        reads_per_molecule=(3, 5), region_len=(300, 900),
+    )
+    path = tmp_path / "reads.fastq.gz"
+    fastx.write_fastq(path, lib.reads)
+    parsed = native.parse_file(path)
+    if parsed is None:
+        import pytest
+
+        pytest.skip("native parser unavailable")
+    widths = (512, 1024, 2048)
+    a = list(bucketing.batch_parsed_reads(parsed, batch_size=8, widths=widths))
+    b = list(bucketing.batch_reads(fastx.read_fastx(path), batch_size=8, widths=widths))
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert x.width == y.width
+        assert x.ids == y.ids
+        np.testing.assert_array_equal(x.codes, y.codes)
+        np.testing.assert_array_equal(x.quals, y.quals)
+        np.testing.assert_array_equal(x.lengths, y.lengths)
+        np.testing.assert_array_equal(x.valid, y.valid)

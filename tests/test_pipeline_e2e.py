@@ -125,3 +125,92 @@ def test_pipeline_refuses_existing_dir_without_resume(sim_library):
     cfg = _base_config(tmp)
     with pytest.raises(FileExistsError):
         run_with_config(cfg)
+
+
+def test_pipeline_untrimmed_reads_with_primer_trim(tmp_path):
+    """Untrimmed reads (full adapter+primer ends) through the trim stage
+    (dorado trim analogue, ref preprocessing.py:7-59) -> exact counts and
+    consensus starting exactly at the UMI."""
+    lib = simulator.simulate_library(
+        seed=19,
+        num_regions=3,
+        molecules_per_region=(2, 3),
+        reads_per_molecule=(5, 8),
+        sub_rate=0.01,
+        ins_rate=0.004,
+        del_rate=0.004,
+        region_len=(1500, 1800),
+        with_adapters=True,
+    )
+    fastx.write_fasta(tmp_path / "reference.fa", lib.reference.items())
+    fq_dir = tmp_path / "fastq_pass" / "barcode01"
+    fq_dir.mkdir(parents=True)
+    fastx.write_fastq(fq_dir / "barcode01.fastq.gz", lib.reads)
+    cfg = RunConfig.from_dict({
+        "reference_file": str(tmp_path / "reference.fa"),
+        "fastq_pass_dir": str(tmp_path / "fastq_pass"),
+        "minimal_length": 1000,
+        "min_reads_per_cluster": 4,
+        "read_batch_size": 128,
+        "polish_method": "poa",
+        "delete_tmp_files": False,
+    })
+    results = run_with_config(cfg)
+    assert results["barcode01"] == lib.true_counts
+
+    # trimmed consensus: primers gone, full region recovered exactly; the
+    # cut position itself may fuzz by a base when read errors fall inside a
+    # primer (dorado trim has the same boundary ambiguity), so the UMI-edge
+    # bases are not required to be byte-exact on every molecule
+    merged = tmp_path / "fastq_pass" / "nano_tcr" / "barcode01" / "fasta" / "merged_consensus.fasta"
+    templates = {
+        m.umi_fwd + lib.reference[m.region] + m.umi_rev for m in lib.molecules
+    }
+    consensus = [rec.sequence for rec in fastx.read_fastx(merged)]
+    assert len(consensus) == len(lib.molecules)
+    region_seqs = set(lib.reference.values())
+    for seq in consensus:
+        assert any(r in seq for r in region_seqs), "region not exactly recovered"
+        assert len(seq) < max(len(t) for t in templates) + 10, "primers not trimmed"
+    exact = sum(1 for seq in consensus if seq in templates)
+    assert exact >= len(consensus) - 1
+
+    # the trim actually fired (logged)
+    ee_log = (tmp_path / "fastq_pass" / "nano_tcr" / "barcode01" / "logs"
+              / "ee_filter.log").read_text()
+    n_trimmed = int(ee_log.split("reads with primer trim: ")[1].split()[0])
+    assert n_trimmed == len(lib.reads)
+
+
+def test_pipeline_mesh_counts_identical(sim_library, tmp_path):
+    """8-device data-sharded run produces counts identical to single-device
+    (the multi-chip path of SURVEY §2.3, on the virtual CPU mesh)."""
+    import shutil
+
+    tmp, lib = sim_library
+    root = tmp_path / "mesh"
+    shutil.copytree(tmp / "fastq_pass" / "barcode01", root / "fastq_pass" / "barcode01")
+    shutil.copy(tmp / "reference.fa", root / "reference.fa")
+    cfg = RunConfig.from_dict({
+        "reference_file": str(root / "reference.fa"),
+        "fastq_pass_dir": str(root / "fastq_pass"),
+        "minimal_length": 1000,
+        "min_reads_per_cluster": 4,
+        "read_batch_size": 128,
+        "polish_method": "poa",
+        "delete_tmp_files": False,
+        "mesh_shape": {"data": 8},
+    })
+    results = run_with_config(cfg)
+    assert results["barcode01"] == lib.true_counts
+
+
+def test_mesh_batch_divisibility_validated(sim_library):
+    tmp, _ = sim_library
+    cfg = _base_config(tmp)
+    cfg.mesh_shape = {"data": 8}
+    cfg.read_batch_size = 100  # not divisible by 8
+    from ont_tcrconsensus_tpu.pipeline.run import make_mesh_from_config
+
+    with pytest.raises(ValueError, match="read_batch_size"):
+        make_mesh_from_config(cfg)

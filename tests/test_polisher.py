@@ -61,22 +61,35 @@ def _leaves(tree):
 
 
 def test_pipeline_adapter_preserves_good_consensus():
+    """The batched adapter: (C, S, W) cluster tile in, (C, W) drafts out."""
     params = polisher.init_params(0)
     rng = np.random.default_rng(0)
     from ont_tcrconsensus_tpu.io import simulator
 
-    template = simulator._rand_seq(rng, 200)
-    codes = np.full((4, 256), encode.PAD_CODE, np.uint8)
-    for i in range(4):
-        s, _ = simulator.mutate(rng, template, 0.01, 0.005, 0.005)
-        enc = encode.encode_seq(s)
-        codes[i, : len(enc)] = enc
-    lens = np.array([int((codes[i] != encode.PAD_CODE).sum()) for i in range(4)], np.int32)
-    cons = np.full((256,), encode.PAD_CODE, np.uint8)
-    t = encode.encode_seq(template)
-    cons[: len(t)] = t
+    C, S, W = 3, 4, 256
+    sub = np.full((C, S, W), encode.PAD_CODE, np.uint8)
+    lens = np.zeros((C, S), np.int32)
+    drafts = np.full((C, W), encode.PAD_CODE, np.uint8)
+    dlens = np.zeros((C,), np.int32)
+    for c in range(C):
+        template = simulator._rand_seq(rng, 200)
+        for i in range(S):
+            s, _ = simulator.mutate(rng, template, 0.01, 0.005, 0.005)
+            enc = encode.encode_seq(s)
+            sub[c, i, : len(enc)] = enc
+            lens[c, i] = len(enc)
+        t = encode.encode_seq(template)
+        drafts[c, : len(t)] = t
+        dlens[c] = len(t)
     fn = polisher.make_pipeline_polisher(params)
-    out, out_len = fn(codes, lens, cons, len(t))
+    out, out_lens = fn(sub, lens, drafts, dlens)
     # untrained model may mutate covered positions, but shape/contract holds
-    assert 0 < out_len <= 256
-    assert (out[out_len:] == encode.PAD_CODE).all()
+    assert out.shape == (C, W)
+    for c in range(C):
+        assert 0 < out_lens[c] <= W
+        assert (out[c, out_lens[c]:] == encode.PAD_CODE).all()
+    # padding clusters stay empty
+    sub0 = np.full((1, S, W), encode.PAD_CODE, np.uint8)
+    out0, l0 = fn(sub0, np.zeros((1, S), np.int32),
+                  np.full((1, W), encode.PAD_CODE, np.uint8), np.zeros((1,), np.int32))
+    assert l0[0] == 0

@@ -122,3 +122,50 @@ def test_library_analysis_pdfs(tmp_path):
                 "nt_length_deviation.pdf", "results_summary.txt"):
         assert pdf in outs, pdf
     assert summary["sensitivity"] == 1.0
+
+
+def test_error_profile_cs_strings():
+    """banded_cs emits reference-syntax cs strings with exact edit cost."""
+    import numpy as np
+
+    from ont_tcrconsensus_tpu.ops import encode
+    from ont_tcrconsensus_tpu.qc.error_profile import banded_cs
+
+    r = encode.encode_seq("ACGTACGTACGTACGTACGT")
+    assert banded_cs(r, r) == ":20"
+    # one substitution in the middle
+    q = r.copy()
+    q[10] = (q[10] + 1) % 4
+    cs = banded_cs(q, r)
+    assert cs.startswith(":10*")
+    assert cs.endswith(":9")
+    # deletion of two bases
+    q = np.concatenate([r[:5], r[7:]])
+    cs = banded_cs(q, r)
+    assert "-" in cs and cs.count("-") == 1
+    # insertion
+    q = np.concatenate([r[:5], np.array([0], np.uint8), r[5:]])
+    cs = banded_cs(q, r)
+    assert "+a" in cs
+
+
+def test_stats_artifacts(tmp_path):
+    from ont_tcrconsensus_tpu.pipeline.assign import AlignStats, LengthStats
+    from ont_tcrconsensus_tpu.qc import artifacts
+    import numpy as np
+
+    stats = AlignStats(n_total=100, n_ee_fail=5, n_trimmed=90, n_aligned=92,
+                       n_short=2, n_long=1, n_low_blast=0, n_pass=89)
+    stats.pre_filter.update(np.array([100, 200, 300]), np.array([10.0, 12.0, 14.0]))
+    stats.post_filter.update(np.array([200, 300]), np.array([12.0, 14.0]))
+    p1 = tmp_path / "fq.log"
+    artifacts.write_fastq_stats_log(stats, str(p1))
+    text = p1.read_text()
+    assert "post_trim_pre_filter\t3\t600\t100\t200.0\t300\t12.00" in text
+    assert "post_filter_pass\t2\t500\t200\t250.0\t300\t13.00" in text
+    p2 = tmp_path / "flag.log"
+    artifacts.write_flagstat_log(stats, str(p2))
+    text = p2.read_text()
+    assert "100 in total" in text
+    assert "92 primary mapped" in text
+    assert "89 passing all filters" in text
