@@ -15,8 +15,9 @@ JAX kernels:
 - ``cluster``   greedy centroid UMI clustering and reference self-homology
                 region clustering driven by device distance batches.
 - ``parallel``  device-mesh management (data-sharded pipeline batches via
-                shard_map, tensor-parallel polisher training) and the HBM
-                batch budgeter.
+                shard_map, tensor-parallel polisher training), the HBM
+                batch budgeter, and multi-host distribution
+                (``jax.distributed`` + shard-by-barcode over DCN).
 - ``io``        host data plane: FASTQ/FASTA streaming, encoding, batching,
                 a C++ fast parser, and a read simulator.
 - ``pipeline``  the end-to-end two-round UMI consensus pipeline: the fused

@@ -28,7 +28,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-BIG = jnp.int32(1 << 20)
+BIG = 1 << 20  # plain int: promoted inside traced code; a jnp constant
+#               here would initialize the XLA backend at import time,
+#               breaking jax.distributed.initialize for importers
 
 
 def _column_step(col, text_char, pattern_mask):

@@ -34,7 +34,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-NEG = jnp.int32(-(1 << 24))
+NEG = -(1 << 24)  # plain int (jnp.full/where promote it); a jnp constant
+#                   here would initialize the XLA backend at import time
 PAD_SENTINEL = 5  # encode.PAD_CODE: never matches (tbase < 4 check)
 
 MATCH = 2

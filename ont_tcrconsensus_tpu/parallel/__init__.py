@@ -1,1 +1,9 @@
-"""parallel subpackage."""
+"""Parallel execution layer: device meshes, HBM budgeting, multi-host.
+
+- :mod:`.mesh` — mesh construction + shardings (data axis for pipeline
+  batches, model axis for polisher tensor parallelism).
+- :mod:`.budget` — the HBM batch budgeter (the reference's medaka memory
+  model, TPU edition).
+- :mod:`.distributed` — ``jax.distributed`` bring-up, shard-by-barcode
+  across hosts, end-of-run count gathering.
+"""

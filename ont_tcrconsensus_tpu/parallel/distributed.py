@@ -1,0 +1,129 @@
+"""Multi-host distribution: ``jax.distributed`` + shard-by-barcode over DCN.
+
+The reference markets "any cluster" but in practice runs Ray on one node
+(/root/reference/ont_tcr_consensus/tcr_consensus.py:73 ``ray.init()``
+local-only; SURVEY §2.3). This module supplies the real multi-host story for
+the TPU build:
+
+- **library-level data parallelism across hosts**: barcode libraries are
+  fully independent (the reference fans them out as Ray tasks,
+  tcr_consensus.py:141-167), so each host process owns a deterministic
+  shard of the library list and runs the complete per-library pipeline on
+  its local chips. No cross-host traffic during a library.
+- **within a host**: the device mesh shards read/cluster batches over ICI
+  (:mod:`.mesh`); the two axes compose (DCN outer, ICI inner) exactly like
+  the scaling-book dp-over-pod recipe.
+- **end-of-run gather**: per-library counts are all-gathered to every
+  process (one variable-length byte collective) so each host can write the
+  complete results CSV; the heavy intermediates never cross DCN.
+
+Initialization: on TPU pods ``jax.distributed.initialize()`` discovers the
+coordinator from the TPU metadata; elsewhere (tests, CPU fleets) pass
+explicit ``coordinator_address``/``num_processes``/``process_id`` or set the
+standard JAX env vars.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Bring up the JAX distributed runtime (idempotent).
+
+    Must run before the first JAX computation of the process — the CLI
+    does this (env-gated, pipeline/cli.py) before importing the pipeline.
+    No-op when already initialized; when auto-detection finds no
+    coordinator (plain single-host run) the error is demoted to a stderr
+    note, but an explicitly requested multi-process bring-up re-raises.
+    """
+    import sys
+
+    import jax
+
+    if jax.distributed.is_initialized():
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (ValueError, RuntimeError) as exc:
+        if num_processes not in (None, 1):
+            raise
+        print(
+            f"jax.distributed not started ({exc}); continuing single-process",
+            file=sys.stderr,
+        )
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def shard_libraries(paths: list[str], index: int | None = None,
+                    count: int | None = None) -> list[str]:
+    """The library shard owned by this process: deterministic round-robin
+    over the *sorted* list, so every process derives the same partition
+    without communicating (the DCN analogue of the reference's per-library
+    Ray fan-out, tcr_consensus.py:141-167)."""
+    index = process_index() if index is None else index
+    count = process_count() if count is None else count
+    if count <= 1:
+        return list(paths)
+    return [p for i, p in enumerate(sorted(paths)) if i % count == index]
+
+
+def barrier(name: str = "barrier") -> None:
+    """Block until every process arrives (no-op single-process)."""
+    from jax.experimental import multihost_utils
+
+    if process_count() > 1:
+        multihost_utils.sync_global_devices(name)
+
+
+def allgather_object(obj) -> list:
+    """All-gather one JSON-serializable object per process.
+
+    Two fixed-shape collectives (max length, then padded uint8 payload) via
+    ``multihost_utils.process_allgather`` — counts dicts are tiny, so this
+    is one DCN round, not a data-plane path.
+    """
+    from jax.experimental import multihost_utils
+
+    if process_count() <= 1:
+        return [obj]
+    payload = np.frombuffer(
+        json.dumps(obj, sort_keys=True).encode(), dtype=np.uint8
+    )
+    n = np.asarray(payload.size, dtype=np.int32)
+    sizes = np.asarray(multihost_utils.process_allgather(n))
+    width = int(sizes.max())
+    padded = np.zeros((width,), np.uint8)
+    padded[: payload.size] = payload
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    out = []
+    for i in range(gathered.shape[0]):
+        out.append(json.loads(bytes(gathered[i, : int(sizes[i])]).decode()))
+    return out
+
+
+def merge_results(local: dict[str, dict[str, int]]) -> dict[str, dict[str, int]]:
+    """Union of every process's {library: {region: count}} results."""
+    merged: dict[str, dict[str, int]] = {}
+    for part in allgather_object(local):
+        merged.update(part)
+    return merged

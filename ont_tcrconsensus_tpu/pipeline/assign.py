@@ -419,7 +419,7 @@ class AssignEngine:
             out_specs["quals"] = d2
         fn = jax.jit(shard_map(
             base, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
-            check_rep=False,
+            check_vma=False,
         ))
         self._sharded_cache[has_quals] = fn
         return fn

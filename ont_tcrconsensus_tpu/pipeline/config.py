@@ -104,6 +104,8 @@ class RunConfig:
     umi_batch_size: int = 4096        # UMIs per distance-matrix tile
     max_read_length: int = 4096       # padded read width cap
     mesh_shape: dict[str, int] | None = None  # e.g. {"data": 8}
+    distributed: bool = False         # multi-host: jax.distributed init +
+    #   shard-by-barcode across processes (parallel/distributed.py)
     resume: bool = False              # stage-level resume from manifest
     write_intermediate_fastas: bool = True  # per-stage fasta artifacts
     error_profile_sample: int = 1000  # reads/library profiled for the cs-tag

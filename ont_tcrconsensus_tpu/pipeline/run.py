@@ -95,6 +95,11 @@ def resolve_batching(cfg: RunConfig, num_refs: int, mesh=None):
 
 
 def run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]:
+    from ont_tcrconsensus_tpu.parallel import distributed as dist
+
+    if cfg.distributed:
+        dist.initialize()  # no-op when already up or single-process
+    n_proc, proc_id = dist.process_count(), dist.process_index()
     if polisher is None and cfg.polish_method == "rnn":
         from ont_tcrconsensus_tpu.models import polisher as polisher_mod
 
@@ -105,23 +110,32 @@ def run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]:
             _log("polish_method=rnn but no bundled weights; using vote consensus only")
     reference = fastx.read_fasta_dict(cfg.reference_file)
     nano_dir = os.path.join(cfg.fastq_pass_dir, "nano_tcr")
-    if os.path.exists(nano_dir) and not cfg.resume:
+    # Every process runs the refusal check BEFORE any process creates the
+    # dir (first barrier orders check vs mkdir), so a pre-existing dir
+    # aborts all hosts consistently instead of parking peers in a barrier
+    # behind a raising process 0.
+    exists = os.path.exists(nano_dir)
+    dist.barrier("nano_dir_check")
+    if exists and not cfg.resume:
         raise FileExistsError(
             f"{nano_dir} exists; set resume=true to continue or remove it"
         )
-    os.makedirs(nano_dir, exist_ok=True)
+    if proc_id == 0:
+        os.makedirs(nano_dir, exist_ok=True)
+    dist.barrier("nano_dir_init")  # dir visible before any other host proceeds
 
     # PHASE A: reference self-homology (tcr_consensus.py:90-105)
     _log("Mapping reference self homology")
     homology = regions_mod.self_homology_map(reference, cfg.cluster_identity)
-    with open(os.path.join(nano_dir, "region_cluster_dict.json"), "w") as fh:
-        json.dump(homology.region_cluster, fh, indent=4)
-    with open(os.path.join(nano_dir, "self_homology_stats.json"), "w") as fh:
-        json.dump(homology.stats, fh, indent=4)
-    artifacts.write_self_homology_log(
-        homology.stats,
-        os.path.join(nano_dir, "ref_homology_out_generate_region_split_dict.log"),
-    )
+    if proc_id == 0:  # shared run-level artifacts: one writer across hosts
+        with open(os.path.join(nano_dir, "region_cluster_dict.json"), "w") as fh:
+            json.dump(homology.region_cluster, fh, indent=4)
+        with open(os.path.join(nano_dir, "self_homology_stats.json"), "w") as fh:
+            json.dump(homology.stats, fh, indent=4)
+        artifacts.write_self_homology_log(
+            homology.stats,
+            os.path.join(nano_dir, "ref_homology_out_generate_region_split_dict.log"),
+        )
 
     blast_id_threshold = cfg.blast_id_threshold
     overlap_consensus = cfg.minimal_region_overlap_consensus
@@ -165,8 +179,14 @@ def run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]:
         )
     if not fastq_list:
         raise FileNotFoundError(f"no fastq files under {cfg.fastq_pass_dir}")
+    if n_proc > 1:
+        # multi-host: each process owns a deterministic library shard over
+        # DCN (parallel/distributed.py); chips within the host shard batches
+        fastq_list = dist.shard_libraries(fastq_list)
+        _log(f"Process {proc_id}/{n_proc} owns {len(fastq_list)} libraries")
 
     results: dict[str, dict[str, int]] = {}
+    failed_libraries: list[tuple[str, str]] = []
     for fastq in fastq_list:
         lay = layout.init_library_dir(fastq, nano_dir, resume=cfg.resume)
         if cfg.resume and lay.stage_done("counts"):
@@ -174,10 +194,30 @@ def run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]:
             counts_csv = os.path.join(lay.counts, "umi_consensus_counts.csv")
             results[lay.library] = _read_counts_csv(counts_csv)
             continue
-        results[lay.library] = _run_library(
-            fastq, lay, cfg, panel, engine, engine_notrim,
-            blast_id_threshold, overlap_consensus, polisher,
-            read_batch, budget,
+        try:
+            results[lay.library] = _run_library(
+                fastq, lay, cfg, panel, engine, engine_notrim,
+                blast_id_threshold, overlap_consensus, polisher,
+                read_batch, budget,
+            )
+        except Exception as exc:
+            # A failed library degrades to a report instead of aborting the
+            # run — and, multi-host, instead of stranding the peers in the
+            # end-of-run collective below (they cannot know this process
+            # would never arrive). Resume retries it: no stage was marked.
+            failed_libraries.append((lay.library, repr(exc)))
+            _log(f"WARNING: library {lay.library} failed and is skipped: {exc!r}")
+    if failed_libraries:
+        with open(os.path.join(nano_dir, f"failed_libraries_p{proc_id}.log"), "w") as fh:
+            for library, err in failed_libraries:
+                fh.write(f"{library}\t{err}\n")
+    if n_proc > 1:
+        results = dist.merge_results(results)
+    if failed_libraries:
+        raise RuntimeError(
+            f"{len(failed_libraries)} library(ies) failed: "
+            f"{[lib for lib, _ in failed_libraries]} — see failed_libraries_*.log; "
+            "rerun with resume=true to retry"
         )
     _log("Done running all barcodes!")
     return results
@@ -198,7 +238,7 @@ def _run_library(fastq, lay, cfg, panel, engine, engine_notrim,
         ]
         return _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
                            overlap_consensus, merged_consensus, timer,
-                           read_batch, budget)
+                           read_batch, budget, round1_complete=True)
 
     # PHASE B + round-1 assignment: ONE fused device pass per batch
     # (trim -> EE -> align -> UMI locate; preprocessing.py:7-159 +
@@ -256,58 +296,85 @@ def _run_library(fastq, lay, cfg, panel, engine, engine_notrim,
         ),
     )
 
-    # round 1: UMI cluster / select / consensus, per region cluster
+    # round 1: UMI cluster / select / consensus, per region cluster.
+    # A poisoned group degrades gracefully: it is skipped AND reported, the
+    # rest of the library completes (the reference behaves the same way for
+    # failed medaka batches, tcr_consensus.py:329-346).
     merged_consensus: list[tuple[str, str]] = []
+    failed_groups: list[tuple[str, str]] = []
     for cluster_key in sorted(groups):
         group_name = f"region_cluster{cluster_key}"
-        with timer.stage("round1_umi_records"):
-            umis = stages.build_umi_records(
-                store, groups[cluster_key], cfg.max_pattern_dist
-            )
-        if not umis:
-            continue
-        if cfg.write_intermediate_fastas:
-            stages.write_umi_fasta(
-                umis, store,
-                os.path.join(lay.umi_fasta, f"{group_name}_detected_umis.fasta"),
-            )
-        with timer.stage("round1_umi_cluster"):
-            selected, stat_rows = stages.cluster_and_select(
-                umis,
-                identity=cfg.vsearch_identity,
-                min_umi_length=cfg.min_umi_length,
-                max_umi_length=cfg.max_umi_length,
-                min_reads_per_cluster=cfg.min_reads_per_cluster,
-                max_reads_per_cluster=cfg.max_reads_per_cluster,
-                balance_strands=cfg.balance_strands,
-            )
-        cdir = os.path.join(lay.clustering, group_name)
-        os.makedirs(cdir, exist_ok=True)
-        stages.write_cluster_stats_tsv(
-            stat_rows, os.path.join(cdir, "vsearch_cluster_stats.tsv")
-        )
-        if not selected:
-            continue
-        _log("Polishing clusters:", library, group_name, f"({len(selected)} clusters)")
-        with timer.stage("round1_polish"):
-            merged_consensus.extend(stages.polish_clusters_stage(
-                selected, group_name, store,
-                max_read_length=cfg.max_read_length,
-                polisher=polisher,
-                budget=budget,
-                cluster_batch=cfg.cluster_batch_size,
+        try:
+            merged_consensus.extend(_round1_group(
+                group_name, groups[cluster_key], store, lay, cfg,
+                polisher, budget, timer, library,
             ))
+        except Exception as exc:
+            failed_groups.append((group_name, repr(exc)))
+            _log(f"WARNING: {group_name} failed and is skipped: {exc!r}")
+    if failed_groups:
+        _log(
+            "Not all umi cluster region fastas were successfully polished! "
+            f"Incomplete: {[g for g, _ in failed_groups]}"
+        )
+        with open(os.path.join(lay.logs, "incomplete_region_clusters.log"), "w") as fh:
+            for group_name, err in failed_groups:
+                fh.write(f"{group_name}\t{err}\n")
 
     fastx.write_fasta(merged_path, merged_consensus)
-    lay.mark_stage_done("round1_consensus")
+    if not failed_groups:
+        # incomplete round 1 is NOT checkpointed: resume must retry the
+        # failed groups instead of reusing a consensus missing them
+        lay.mark_stage_done("round1_consensus")
     return _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
                        overlap_consensus, merged_consensus, timer,
-                       read_batch, budget)
+                       read_batch, budget,
+                       round1_complete=not failed_groups)
+
+
+def _round1_group(group_name, parts, store, lay, cfg, polisher, budget,
+                  timer, library) -> list[tuple[str, str]]:
+    """UMI cluster -> subread select -> consensus for one region cluster."""
+    with timer.stage("round1_umi_records"):
+        umis = stages.build_umi_records(store, parts, cfg.max_pattern_dist)
+    if not umis:
+        return []
+    if cfg.write_intermediate_fastas:
+        stages.write_umi_fasta(
+            umis, store,
+            os.path.join(lay.umi_fasta, f"{group_name}_detected_umis.fasta"),
+        )
+    with timer.stage("round1_umi_cluster"):
+        selected, stat_rows = stages.cluster_and_select(
+            umis,
+            identity=cfg.vsearch_identity,
+            min_umi_length=cfg.min_umi_length,
+            max_umi_length=cfg.max_umi_length,
+            min_reads_per_cluster=cfg.min_reads_per_cluster,
+            max_reads_per_cluster=cfg.max_reads_per_cluster,
+            balance_strands=cfg.balance_strands,
+        )
+    cdir = os.path.join(lay.clustering, group_name)
+    os.makedirs(cdir, exist_ok=True)
+    stages.write_cluster_stats_tsv(
+        stat_rows, os.path.join(cdir, "vsearch_cluster_stats.tsv")
+    )
+    if not selected:
+        return []
+    _log("Polishing clusters:", library, group_name, f"({len(selected)} clusters)")
+    with timer.stage("round1_polish"):
+        return stages.polish_clusters_stage(
+            selected, group_name, store,
+            max_read_length=cfg.max_read_length,
+            polisher=polisher,
+            budget=budget,
+            cluster_batch=cfg.cluster_batch_size,
+        )
 
 
 def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
                 overlap_consensus, merged_consensus, timer,
-                read_batch, budget) -> dict[str, int]:
+                read_batch, budget, round1_complete: bool = True) -> dict[str, int]:
     library = lay.library
 
     # round 2: consensus align + blast-id filter + split by exact region
@@ -353,55 +420,22 @@ def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
     if cfg.write_intermediate_fastas:
         stages.write_region_fastas(region_groups, cons_store, lay.region_fasta, "region_")
 
-    # round 2: UMI dedup clustering at consensus identity
+    # round 2: UMI dedup clustering at consensus identity. Per-region
+    # failures degrade gracefully like round 1: skip, report, continue.
     region_counts: dict[str, int] = {}
     region_cluster_umis: dict[str, list[str]] = {}
+    failed_regions: list[tuple[str, str]] = []
     for region, parts in sorted(region_groups.items()):
-        with timer.stage("round2_umi_records"):
-            umis = stages.build_umi_records(cons_store, parts, cfg.max_pattern_dist)
-        if not umis:
-            continue
-        if cfg.write_intermediate_fastas:
-            stages.write_umi_fasta(
-                umis, cons_store,
-                os.path.join(
-                    lay.consensus_umi_fasta, f"region_{region}_detected_umis.fasta"
-                ),
-            )
-        with timer.stage("round2_umi_cluster"):
-            selected, stat_rows = stages.cluster_and_select(
-                umis,
-                identity=cfg.vsearch_identity_consensus,
-                min_umi_length=cfg.min_umi_length,
-                max_umi_length=cfg.max_umi_length,
-                min_reads_per_cluster=1,
-                max_reads_per_cluster=cfg.max_reads_per_cluster,
-                balance_strands=False,
-            )
-        rdir = os.path.join(lay.clustering_consensus, f"region_{region}")
-        os.makedirs(rdir, exist_ok=True)
-        stages.write_cluster_stats_tsv(
-            stat_rows, os.path.join(rdir, "vsearch_cluster_stats.tsv")
-        )
-        # smolecule parity: one entry per written member, named by cluster
-        # (parse_umi_clusters.py:104-116)
-        if cfg.write_intermediate_fastas:
-            smolecule = os.path.join(rdir, "smolecule_clusters.fa")
-            entries = [
-                (str(cl.cluster_id),
-                 cons_store.blocks[m.block].decode_one(m.row))
-                for cl in selected for m in cl.members
-            ]
-            fastx.write_fasta(smolecule, entries)
-        # Count = round-2 CLUSTERS (unique molecules). Documented divergence:
-        # the reference greps smolecule headers (count.py:9-20), i.e. written
-        # members — identical whenever round 1 yields one cluster per
-        # molecule, but it double-counts a molecule whose round-1 UMI split
-        # produced two consensus even after its own round-2 dedup merged
-        # them into one cluster. Counting clusters is the molecule-accurate
-        # reading of "per-TCR UMI counts" (reference README.md:2).
-        region_counts[region] = len(selected)
-        region_cluster_umis[region] = [cl.members[0].combined for cl in selected]
+        try:
+            _round2_region(region, parts, cons_store, lay, cfg, timer,
+                           region_counts, region_cluster_umis)
+        except Exception as exc:
+            failed_regions.append((region, repr(exc)))
+            _log(f"WARNING: round-2 region {region} failed and is skipped: {exc!r}")
+    if failed_regions:
+        with open(os.path.join(lay.logs, "incomplete_regions.log"), "w") as fh:
+            for region, err in failed_regions:
+                fh.write(f"{region}\t{err}\n")
 
     stages.write_counts_csv(region_counts, lay.counts)
     if cfg.compare_umi_overlap_between_regions:
@@ -410,7 +444,10 @@ def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
             region_cluster_umis, lay.logs, cfg.overlapping_umi_edit_threshold
         )
     timer.write_tsv(os.path.join(lay.logs, "stage_timing.tsv"))
-    lay.mark_stage_done("counts")
+    if round1_complete and not failed_regions:
+        # incomplete counts are not checkpointed: resume must retry the
+        # failed groups/regions instead of trusting a partial CSV
+        lay.mark_stage_done("counts")
 
     if cfg.delete_tmp_files:
         for d in (lay.region_cluster_fasta, lay.clustering, lay.umi_fasta,
@@ -419,6 +456,56 @@ def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
             shutil.rmtree(d, ignore_errors=True)
 
     return region_counts
+
+
+def _round2_region(region, parts, cons_store, lay, cfg, timer,
+                   region_counts, region_cluster_umis) -> None:
+    """Round-2 dedup clustering + counting for one exact region."""
+    with timer.stage("round2_umi_records"):
+        umis = stages.build_umi_records(cons_store, parts, cfg.max_pattern_dist)
+    if not umis:
+        return
+    if cfg.write_intermediate_fastas:
+        stages.write_umi_fasta(
+            umis, cons_store,
+            os.path.join(
+                lay.consensus_umi_fasta, f"region_{region}_detected_umis.fasta"
+            ),
+        )
+    with timer.stage("round2_umi_cluster"):
+        selected, stat_rows = stages.cluster_and_select(
+            umis,
+            identity=cfg.vsearch_identity_consensus,
+            min_umi_length=cfg.min_umi_length,
+            max_umi_length=cfg.max_umi_length,
+            min_reads_per_cluster=1,
+            max_reads_per_cluster=cfg.max_reads_per_cluster,
+            balance_strands=False,
+        )
+    rdir = os.path.join(lay.clustering_consensus, f"region_{region}")
+    os.makedirs(rdir, exist_ok=True)
+    stages.write_cluster_stats_tsv(
+        stat_rows, os.path.join(rdir, "vsearch_cluster_stats.tsv")
+    )
+    # smolecule parity: one entry per written member, named by cluster
+    # (parse_umi_clusters.py:104-116)
+    if cfg.write_intermediate_fastas:
+        smolecule = os.path.join(rdir, "smolecule_clusters.fa")
+        entries = [
+            (str(cl.cluster_id),
+             cons_store.blocks[m.block].decode_one(m.row))
+            for cl in selected for m in cl.members
+        ]
+        fastx.write_fasta(smolecule, entries)
+    # Count = round-2 CLUSTERS (unique molecules). Documented divergence:
+    # the reference greps smolecule headers (count.py:9-20), i.e. written
+    # members — identical whenever round 1 yields one cluster per
+    # molecule, but it double-counts a molecule whose round-1 UMI split
+    # produced two consensus even after its own round-2 dedup merged
+    # them into one cluster. Counting clusters is the molecule-accurate
+    # reading of "per-TCR UMI counts" (reference README.md:2).
+    region_counts[region] = len(selected)
+    region_cluster_umis[region] = [cl.members[0].combined for cl in selected]
 
 
 def _write_align_log(stats: stages.AlignStats, path: str) -> None:

@@ -555,6 +555,16 @@ def run_library_analysis(
         rows = read_two_column_csv(sub_csv)
         plot_subreads_per_umi_hist(rows, os.path.join(out_dir, "subreads_per_umi.pdf"))
         plot_blast_id_vs_subreads_box(rows, os.path.join(out_dir, "blast_id_vs_subreads.pdf"))
+        # precision-vs-depth report (minimap2_align.py:362-435 analogue, fed
+        # by the pipeline's own subreads/blast-id artifact)
+        per_depth = estimate_precision_at_num_subreads(rows)
+        with open(os.path.join(out_dir, "precision_at_num_subreads.tsv"), "w") as fh:
+            fh.write("num_subreads\tn_consensus\tn_perfect\tprecision\n")
+            for n, st in per_depth.items():
+                fh.write(
+                    f"{n}\t{st['n_consensus']:.0f}\t{st['n_perfect']:.0f}"
+                    f"\t{st['precision']:.6f}\n"
+                )
     plot_umi_count_hist(counts, os.path.join(out_dir, "umi_count_hist.pdf"),
                         log10_threshold=log10_threshold)
     plot_plate_heatmap(counts, os.path.join(out_dir, "plate_heatmap.pdf"),

@@ -97,7 +97,13 @@ def banded_cs(query: np.ndarray, ref: np.ndarray, band: int = 96) -> str:
         prev = cur
         lo = nlo
 
-    # traceback
+    return _traceback_cs(q, r, ptr, lo_of, W)
+
+
+def _traceback_cs(q, r, ptr, lo_of, W) -> str:
+    """Emit the cs string from a filled pointer matrix (shared by the
+    single-read and batched fills)."""
+    n, m = len(q), len(r)
     i, jpos = n, m
     ops: list[tuple[str, str]] = []  # (op, payload)
     while i > 0 or jpos > 0:
@@ -154,7 +160,118 @@ def banded_cs(query: np.ndarray, ref: np.ndarray, band: int = 96) -> str:
     return "".join(out)
 
 
-def profile_store(store, panel, sample_size: int = 1000, seed: int = 0):
+def banded_cs_batch(queries: list[np.ndarray], refs: list[np.ndarray],
+                    band: int = 96) -> list[str]:
+    """Batched :func:`banded_cs`: one vectorized DP fill across reads.
+
+    Bit-identical to the single-read version (per-read band geometry is
+    preserved by masking each read's out-of-band lanes), but the row loop
+    runs once for the whole batch — the QC profiling pass drops from
+    ~0.2 s/read of small-array numpy calls to a few seconds per thousand
+    reads. Band-width outliers (clipped alignments with |n-m| far above the
+    band, whose wide lanes would inflate the shared pointer tensor for the
+    whole batch) fall back to the single-read path.
+    """
+    B = len(queries)
+    if B == 0:
+        return []
+    qs = [np.asarray(q, dtype=np.int16) for q in queries]
+    rs = [np.asarray(r, dtype=np.int16) for r in refs]
+    ns = np.array([len(q) for q in qs], np.int64)
+    ms = np.array([len(r) for r in rs], np.int64)
+    # degenerate rows handled scalar (identical to banded_cs early-outs)
+    out: list[str | None] = [None] * B
+    halves_all = np.maximum(band // 2, np.abs(ns - ms) + 8)
+    w_cap = 2 * max(band // 2, 128) + 1
+    live = []
+    for b in range(B):
+        if ns[b] == 0:
+            out[b] = f"-{''.join(_BASE[c] for c in rs[b])}" if ms[b] else ""
+        elif ms[b] == 0:
+            out[b] = f"+{''.join(_BASE[c] for c in qs[b])}"
+        elif 2 * halves_all[b] + 1 > w_cap:
+            out[b] = banded_cs(qs[b], rs[b], band=band)  # band outlier
+        else:
+            live.append(b)
+    if not live:
+        return [s if s is not None else "" for s in out]
+
+    idx = np.array(live)
+    n_arr, m_arr = ns[idx], ms[idx]
+    L = len(idx)
+    n_max = int(n_arr.max())
+    m_max = int(m_arr.max())
+    halves = halves_all[idx]
+    Ws = 2 * halves + 1
+    W = int(Ws.max())
+    BIG = 1 << 20
+
+    qpad = np.zeros((L, n_max), np.int16)
+    rpad = np.zeros((L, m_max), np.int16)
+    for k, b in enumerate(live):
+        qpad[k, : ns[b]] = qs[b]
+        rpad[k, : ms[b]] = rs[b]
+
+    # per-read, per-row band starts: row_lo(i) = clip(round(i*m/n) - half, 0, m)
+    # (multiply-then-divide like banded_cs's round(i*m/n): exact int product
+    # before the fp divide, so half-way cases round identically)
+    rows = np.arange(n_max + 1, dtype=np.int64)[None, :]
+    centers = np.rint(rows * m_arr[:, None] / n_arr[:, None]).astype(np.int64)
+    lo_all = np.clip(centers - halves[:, None], 0, None)
+    lo_all = np.minimum(lo_all, m_arr[:, None])          # (L, n_max+1)
+
+    ptr = np.zeros((L, n_max + 1, W), dtype=np.uint8)
+    lanes = np.arange(W, dtype=np.int64)[None, :]        # (1, W)
+    lane_ok = lanes < Ws[:, None]                        # per-read band width
+
+    # row 0: D[0][j] = j deletions for j in [lo, lo+W) ∩ [0, m]
+    js0 = lo_all[:, 0:1] + lanes
+    valid0 = lane_ok & (js0 <= m_arr[:, None])
+    prev = np.where(valid0, js0, BIG).astype(np.int64)
+    ptr[:, 0, :] = np.where(valid0, 2, 0)
+
+    for i in range(1, n_max + 1):
+        alive = i <= n_arr                               # (L,)
+        nlo = lo_all[:, i]
+        shift = nlo - lo_all[:, i - 1]                   # (L,)
+        # aligned_prev[t] = prev at lane (t + shift - 1); [:W] = diag, [1:] = up
+        src = lanes + shift[:, None] - 1                 # (L, W) for diag
+        okm = (src >= 0) & (src < W)
+        diag = np.where(okm, np.take_along_axis(prev, np.clip(src, 0, W - 1), 1), BIG)
+        src_up = src + 1
+        oku = (src_up >= 0) & (src_up < W)
+        up = np.where(oku, np.take_along_axis(prev, np.clip(src_up, 0, W - 1), 1), BIG)
+
+        js = nlo[:, None] + lanes                        # (L, W) ref positions
+        valid = lane_ok & (js <= m_arr[:, None]) & alive[:, None]
+        qi = qpad[np.arange(L), np.minimum(i, n_arr) - 1][:, None]  # (L, 1)
+        rj = np.take_along_axis(rpad, np.clip(js - 1, 0, m_max - 1), 1)
+        sub = np.where((rj == qi) & (qi < 4) & (rj < 4), 0, 1)
+        d = np.where(js >= 1, diag + sub, BIG)
+        u = up + 1
+        best = np.minimum(d, u)
+        p = np.where(u < d, 1, 0).astype(np.uint8)       # ties prefer diag
+        best = np.where(valid, best, BIG)
+        # left (ref-gap) chains collapse under unit cost: prefix-min cascade
+        run_min = np.minimum.accumulate(best - lanes, axis=1)
+        left = np.take_along_axis(run_min, np.maximum(lanes - 1, 0), 1) + lanes
+        left[:, 0] = BIG
+        take_left = (left < best) & valid
+        best = np.where(take_left, left, best)
+        p = np.where(take_left, 2, p).astype(np.uint8)
+        cur = np.where(valid, best, BIG).astype(np.int64)
+        ptr[:, i, :] = np.where(valid, p, 0)
+        prev = np.where(alive[:, None], cur, prev)
+
+    for k, b in enumerate(live):
+        out[b] = _traceback_cs(
+            qs[b], rs[b], ptr[k], lo_all[k, : ns[b] + 1], int(Ws[k])
+        )
+    return [s if s is not None else "" for s in out]
+
+
+def profile_store(store, panel, sample_size: int = 1000, seed: int = 0,
+                  chunk: int = 128):
     """cs-tag counters over a read-store sample.
 
     Returns (tag_counter, tag->region counter, tag->blast_id counter) — the
@@ -175,19 +292,26 @@ def profile_store(store, panel, sample_size: int = 1000, seed: int = 0):
     tag_counter: Counter = Counter()
     tag_region: dict[str, Counter] = defaultdict(Counter)
     tag_blast: dict[str, Counter] = defaultdict(Counter)
-    for bi, r in handles:
-        blk = store.blocks[bi]
-        ln = int(blk.lens[r])
-        qcodes = blk.codes[r, :ln]
-        if blk.is_rev[r]:
-            qcodes = encode.revcomp_codes(qcodes)
-        ridx = int(blk.region_idx[r])
-        rs, re = int(blk.ref_start[r]), int(blk.ref_end[r])
-        ref_codes = panel.codes[ridx, rs:re]
-        tag = banded_cs(qcodes, ref_codes)
-        tag_counter[tag] += 1
-        tag_region[tag][panel.names[ridx]] += 1
-        tag_blast[tag][round(float(blk.blast_id[r]), 6)] += 1
+    for s in range(0, len(handles), chunk):
+        part = handles[s : s + chunk]
+        queries, ref_spans = [], []
+        for bi, r in part:
+            blk = store.blocks[bi]
+            ln = int(blk.lens[r])
+            qcodes = blk.codes[r, :ln]
+            if blk.is_rev[r]:
+                qcodes = encode.revcomp_codes(qcodes)
+            queries.append(qcodes)
+            ridx = int(blk.region_idx[r])
+            rs, re = int(blk.ref_start[r]), int(blk.ref_end[r])
+            ref_spans.append(panel.codes[ridx, rs:re])
+        tags = banded_cs_batch(queries, ref_spans)
+        for (bi, r), tag in zip(part, tags):
+            blk = store.blocks[bi]
+            ridx = int(blk.region_idx[r])
+            tag_counter[tag] += 1
+            tag_region[tag][panel.names[ridx]] += 1
+            tag_blast[tag][round(float(blk.blast_id[r]), 6)] += 1
     return tag_counter, tag_region, tag_blast
 
 

@@ -154,3 +154,36 @@ def test_merge_close_centroids_unit():
     )
     assert list(new_centroids) == [0, 2]
     assert list(new_labels) == [0, 0, 1]
+
+
+def test_error_rich_longest_read_does_not_fragment_molecule():
+    """Star-policy regression (bench-scale counts bug): when the longest
+    read of a molecule carries several errors, every member pair still
+    clears 0.93 pairwise but a centroid-star anchored on the longest read
+    splits the molecule. Component clustering must keep it whole."""
+    import numpy as np
+
+    from ont_tcrconsensus_tpu.cluster.umi import cluster_umis
+
+    rng = np.random.default_rng(3)
+    bases = "ACGT"
+    center = "".join(rng.choice(list(bases)) for _ in range(64))
+
+    def mutate(s, n_sub):
+        s = list(s)
+        for p in rng.choice(len(s), size=n_sub, replace=False):
+            s[p] = bases[(bases.index(s[p]) + 1) % 4]
+        return "".join(s)
+
+    # longest read: 3 errors + an extra base (so it anchors the length sort)
+    umis = [mutate(center, 3) + "A"]
+    umis += [mutate(center, int(rng.integers(0, 3))) for _ in range(5)]
+    other = "".join(rng.choice(list(bases)) for _ in range(64))
+    umis += [mutate(other, 1) for _ in range(3)]
+
+    res = cluster_umis(umis, 0.93)
+    assert res.num_clusters == 2
+    labels = np.asarray(res.labels)
+    assert len(set(labels[:6])) == 1, "molecule fragmented"
+    assert len(set(labels[6:])) == 1
+    assert labels[0] != labels[6]

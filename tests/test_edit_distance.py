@@ -89,3 +89,51 @@ def test_empty_vs_nonempty():
     bl0 = np.array([0], dtype=np.int32)
     d = np.asarray(edit_distance.pairwise(ab, al, bb, bl0))
     assert d[0] == 4
+
+
+def _dovetail_oracle(a: str, b: str, k: int = 8) -> int:
+    """O(nm) oracle: min over all cells of D[i][j] + relu overhangs."""
+    m, n = len(a), len(b)
+    D = np.zeros((m + 1, n + 1), dtype=np.int64)
+    D[:, 0] = np.maximum(np.arange(m + 1) - k, 0)
+    D[0, :] = np.maximum(np.arange(n + 1) - k, 0)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            D[i, j] = min(
+                D[i - 1, j - 1] + (a[i - 1] != b[j - 1]),
+                D[i - 1, j] + 1,
+                D[i, j - 1] + 1,
+            )
+    tail_a = np.maximum(m - np.arange(m + 1) - k, 0)[:, None]
+    tail_b = np.maximum(n - np.arange(n + 1) - k, 0)[None, :]
+    return int((D + tail_a + tail_b).min())
+
+
+def test_pairwise_dovetail_matches_oracle():
+    rng = np.random.default_rng(7)
+    a = _rand_seqs(rng, 24, 40, 80)
+    b = _rand_seqs(rng, 24, 40, 80)
+    # include boundary-fuzz pairs: same core, ragged ends
+    core = _rand_seqs(rng, 8, 56, 64)
+    for c in core:
+        a.append("GG" + c)
+        b.append(c + "TTA")
+    ca, la = encode.encode_batch(a, pad_to=96)
+    cb, lb = encode.encode_batch(b, pad_to=96)
+    got = np.asarray(edit_distance.pairwise_dovetail(ca, la, cb, lb))
+    want = [_dovetail_oracle(x, y) for x, y in zip(a, b)]
+    assert got.tolist() == want
+
+
+def test_dovetail_frees_boundary_fuzz_but_counts_internal_errors():
+    core = "ACGTTGCA" * 8  # 64 nt
+    mutated = core[:30] + "T" + core[31:]  # one internal substitution
+    ca, la = encode.encode_batch(["AGT" + core], pad_to=96)
+    cb, lb = encode.encode_batch([mutated + "CC"], pad_to=96)
+    d = int(np.asarray(edit_distance.pairwise_dovetail(ca, la, cb, lb))[0])
+    assert d == 1  # terminal fuzz free, internal sub counted
+    # degenerate empty overlap is NOT free for long sequences
+    ca, la = encode.encode_batch(["A" * 64], pad_to=96)
+    cb, lb = encode.encode_batch(["C" * 64], pad_to=96)
+    d = int(np.asarray(edit_distance.pairwise_dovetail(ca, la, cb, lb))[0])
+    assert d >= 64 - 2 * 8 - 8

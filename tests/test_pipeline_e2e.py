@@ -182,6 +182,60 @@ def test_pipeline_untrimmed_reads_with_primer_trim(tmp_path):
     assert n_trimmed == len(lib.reads)
 
 
+def test_pipeline_degrades_gracefully_on_poisoned_group(sim_library, tmp_path, monkeypatch):
+    """One failing region cluster must not abort the library: the rest
+    completes and the failure is reported (ref tcr_consensus.py:329-346)."""
+    import shutil
+
+    from ont_tcrconsensus_tpu.pipeline import stages
+
+    tmp, lib = sim_library
+    root = tmp_path / "poison"
+    shutil.copytree(tmp / "fastq_pass" / "barcode01", root / "fastq_pass" / "barcode01")
+    shutil.copy(tmp / "reference.fa", root / "reference.fa")
+
+    real_polish = stages.polish_clusters_stage
+    poisoned = "region_cluster0"
+
+    def flaky_polish(selected, group_name, store, **kw):
+        if group_name == poisoned:
+            raise RuntimeError("injected failure")
+        return real_polish(selected, group_name, store, **kw)
+
+    monkeypatch.setattr(stages, "polish_clusters_stage", flaky_polish)
+    cfg = RunConfig.from_dict({
+        "reference_file": str(root / "reference.fa"),
+        "fastq_pass_dir": str(root / "fastq_pass"),
+        "minimal_length": 1000,
+        "min_reads_per_cluster": 4,
+        "read_batch_size": 128,
+        "polish_method": "poa",
+        "delete_tmp_files": False,
+    })
+    results = run_with_config(cfg)
+
+    nano = root / "fastq_pass" / "nano_tcr" / "barcode01"
+    report = (nano / "logs" / "incomplete_region_clusters.log").read_text()
+    assert poisoned in report and "injected failure" in report
+    # an incomplete library is NOT checkpointed: resume must retry it
+    mpath = nano / "stage_manifest.json"
+    manifest = json.loads(mpath.read_text()) if mpath.exists() else {}
+    assert "round1_consensus" not in manifest
+    assert "counts" not in manifest
+    # every region outside the poisoned cluster still has exact counts
+    cluster_map = json.loads(
+        (root / "fastq_pass" / "nano_tcr" / "region_cluster_dict.json").read_text()
+    )
+    unaffected = {r for r, c in cluster_map.items() if c != 0}
+    assert unaffected, "poisoned cluster swallowed every region"
+    got = results["barcode01"]
+    for region in unaffected:
+        assert got.get(region) == lib.true_counts.get(region)
+    for region, c in cluster_map.items():
+        if c == 0:
+            assert region not in got
+
+
 def test_pipeline_mesh_counts_identical(sim_library, tmp_path):
     """8-device data-sharded run produces counts identical to single-device
     (the multi-chip path of SURVEY §2.3, on the virtual CPU mesh)."""

@@ -149,6 +149,43 @@ def test_error_profile_cs_strings():
     assert "+a" in cs
 
 
+def test_error_profile_batch_matches_single():
+    """banded_cs_batch is bit-identical to per-read banded_cs across ragged
+    lengths, strand-flipped reads, and degenerate empty inputs."""
+    import numpy as np
+
+    from ont_tcrconsensus_tpu.qc.error_profile import banded_cs, banded_cs_batch
+
+    rng = np.random.default_rng(5)
+    queries, refs = [], []
+    for _ in range(40):
+        m = int(rng.integers(1, 400))
+        r = rng.integers(0, 4, size=m).astype(np.uint8)
+        q = list(r)
+        # mutate: subs, indels at ~5%
+        i = 0
+        out = []
+        while i < len(q):
+            roll = rng.random()
+            if roll < 0.02:
+                out.append(int(rng.integers(0, 4)))  # sub
+            elif roll < 0.04:
+                pass  # deletion
+            elif roll < 0.06:
+                out.extend([q[i], int(rng.integers(0, 4))])  # insertion
+            else:
+                out.append(q[i])
+            i += 1
+        queries.append(np.array(out, np.uint8))
+        refs.append(r)
+    # degenerate rows
+    queries += [np.zeros(0, np.uint8), np.array([1, 2], np.uint8)]
+    refs += [np.array([1, 2, 3], np.uint8), np.zeros(0, np.uint8)]
+    batch = banded_cs_batch(queries, refs)
+    single = [banded_cs(q, r) for q, r in zip(queries, refs)]
+    assert batch == single
+
+
 def test_stats_artifacts(tmp_path):
     from ont_tcrconsensus_tpu.pipeline.assign import AlignStats, LengthStats
     from ont_tcrconsensus_tpu.qc import artifacts
