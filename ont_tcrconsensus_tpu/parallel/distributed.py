@@ -32,14 +32,19 @@ import numpy as np
 
 def initialize(coordinator_address: str | None = None,
                num_processes: int | None = None,
-               process_id: int | None = None) -> None:
+               process_id: int | None = None,
+               required: bool = False) -> None:
     """Bring up the JAX distributed runtime (idempotent).
 
     Must run before the first JAX computation of the process — the CLI
     does this (env-gated, pipeline/cli.py) before importing the pipeline.
-    No-op when already initialized; when auto-detection finds no
-    coordinator (plain single-host run) the error is demoted to a stderr
-    note, but an explicitly requested multi-process bring-up re-raises.
+    No-op when already initialized. ``required=True`` (what
+    ``RunConfig.distributed`` requests) re-raises any bring-up failure:
+    silently degrading an intended multi-host run to N independent
+    single-process runs would race every host over the same output tree.
+    Without ``required``, an auto-detection miss (plain single-host run)
+    is demoted to a stderr note; an explicit ``num_processes`` > 1 always
+    re-raises.
     """
     import sys
 
@@ -54,7 +59,7 @@ def initialize(coordinator_address: str | None = None,
             process_id=process_id,
         )
     except (ValueError, RuntimeError) as exc:
-        if num_processes not in (None, 1):
+        if required or num_processes not in (None, 1):
             raise
         print(
             f"jax.distributed not started ({exc}); continuing single-process",

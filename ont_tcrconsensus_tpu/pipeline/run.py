@@ -98,7 +98,10 @@ def run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]:
     from ont_tcrconsensus_tpu.parallel import distributed as dist
 
     if cfg.distributed:
-        dist.initialize()  # no-op when already up or single-process
+        # no-op when already up (e.g. the CLI initialized pre-import);
+        # required: a failed bring-up must abort, not degrade to N racing
+        # single-process runs
+        dist.initialize(required=True)
     n_proc, proc_id = dist.process_count(), dist.process_index()
     if polisher is None and cfg.polish_method == "rnn":
         from ont_tcrconsensus_tpu.models import polisher as polisher_mod
@@ -188,36 +191,48 @@ def run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]:
     results: dict[str, dict[str, int]] = {}
     failed_libraries: list[tuple[str, str]] = []
     for fastq in fastq_list:
-        lay = layout.init_library_dir(fastq, nano_dir, resume=cfg.resume)
-        if cfg.resume and lay.stage_done("counts"):
-            _log("Library already complete:", lay.library)
-            counts_csv = os.path.join(lay.counts, "umi_consensus_counts.csv")
-            results[lay.library] = _read_counts_csv(counts_csv)
-            continue
+        # The whole per-library unit is guarded (dir init and resume reload
+        # included): a failed library degrades to a report instead of
+        # aborting the run — and, multi-host, instead of stranding the
+        # peers in the end-of-run collective below (they cannot know this
+        # process would never arrive). Resume retries it: no stage marked.
         try:
+            lay = layout.init_library_dir(fastq, nano_dir, resume=cfg.resume)
+            if cfg.resume and lay.stage_done("counts"):
+                _log("Library already complete:", lay.library)
+                counts_csv = os.path.join(lay.counts, "umi_consensus_counts.csv")
+                results[lay.library] = _read_counts_csv(counts_csv)
+                continue
             results[lay.library] = _run_library(
                 fastq, lay, cfg, panel, engine, engine_notrim,
                 blast_id_threshold, overlap_consensus, polisher,
                 read_batch, budget,
             )
         except Exception as exc:
-            # A failed library degrades to a report instead of aborting the
-            # run — and, multi-host, instead of stranding the peers in the
-            # end-of-run collective below (they cannot know this process
-            # would never arrive). Resume retries it: no stage was marked.
-            failed_libraries.append((lay.library, repr(exc)))
-            _log(f"WARNING: library {lay.library} failed and is skipped: {exc!r}")
+            library = layout.library_name_from_fastq(fastq)
+            failed_libraries.append((library, repr(exc)))
+            _log(f"WARNING: library {library} failed and is skipped: {exc!r}")
     if failed_libraries:
         with open(os.path.join(nano_dir, f"failed_libraries_p{proc_id}.log"), "w") as fh:
             for library, err in failed_libraries:
                 fh.write(f"{library}\t{err}\n")
     if n_proc > 1:
-        results = dist.merge_results(results)
+        # gather counts AND failure markers so every host sees the same
+        # global picture — a failure on one shard must fail the whole run
+        # on all hosts, not just the shard's owner
+        merged: dict[str, dict[str, int]] = {}
+        all_failed: list[tuple[str, str]] = []
+        for part in dist.allgather_object(
+            {"results": results, "failed": failed_libraries}
+        ):
+            merged.update(part["results"])
+            all_failed.extend(tuple(f) for f in part["failed"])
+        results, failed_libraries = merged, all_failed
     if failed_libraries:
         raise RuntimeError(
             f"{len(failed_libraries)} library(ies) failed: "
-            f"{[lib for lib, _ in failed_libraries]} — see failed_libraries_*.log; "
-            "rerun with resume=true to retry"
+            f"{sorted(lib for lib, _ in failed_libraries)} — see "
+            "failed_libraries_*.log; rerun with resume=true to retry"
         )
     _log("Done running all barcodes!")
     return results

@@ -124,6 +124,36 @@ def test_library_analysis_pdfs(tmp_path):
     assert summary["sensitivity"] == 1.0
 
 
+def test_analysis_cli(tmp_path, capsys):
+    """Console-script analysis driver (notebook analogue) over an output tree."""
+    from ont_tcrconsensus_tpu.qc.analysis_cli import main
+
+    nano = tmp_path / "nano_tcr"
+    lib = nano / "barcode01"
+    (lib / "logs").mkdir(parents=True)
+    (lib / "counts").mkdir()
+    (lib / "counts" / "umi_consensus_counts.csv").write_text(
+        "TCR,Count\nTCR1,40\nTCR2,25\n"
+    )
+    ref = tmp_path / "reference.fa"
+    ref.write_text(">TCR1\nACGT\n>TCR2\nTTTT\n")
+    assert main([str(nano), str(ref)]) == 0
+    out = capsys.readouterr().out
+    assert '"sensitivity": 1.0' in out
+    assert (lib / "outs" / "results_summary.txt").exists()
+    assert (lib / "outs" / "umi_count_hist.pdf").exists()
+
+    # precision-at-depth report appears when the subreads artifact exists
+    (lib / "logs" / "merged_consensus_number_of_subreads_blast_id.csv").write_text(
+        "number_of_subreads,blast_id\n4,1.0\n4,0.99\n6,1.0\n"
+    )
+    assert main([str(nano), str(ref)]) == 0
+    tsv = (lib / "outs" / "precision_at_num_subreads.tsv").read_text().splitlines()
+    assert tsv[0] == "num_subreads\tn_consensus\tn_perfect\tprecision"
+    assert tsv[1].startswith("4\t2\t1\t0.5")
+    assert tsv[2].startswith("6\t1\t1\t1")
+
+
 def test_error_profile_cs_strings():
     """banded_cs emits reference-syntax cs strings with exact edit cost."""
     import numpy as np
