@@ -95,7 +95,6 @@ class RunConfig:
     polish_method: str = "rnn"
 
     # --- TPU execution (new; no reference analogue) ---
-    backend: str = "jax"              # "jax" | "numpy" (debug)
     hbm_budget_gb: float | None = None  # None -> detect chip HBM (the one
     #   scheduler knob; batch sizes derive from it — parallel/budget.py,
     #   replacing the reference's medaka memory model)
@@ -190,8 +189,6 @@ class RunConfig:
             raise ValueError("min_reads_per_cluster > max_reads_per_cluster")
         if self.polish_method not in ("poa", "rnn"):
             raise ValueError(f"polish_method={self.polish_method!r} not in ('poa', 'rnn')")
-        if self.backend not in ("jax", "numpy"):
-            raise ValueError(f"backend={self.backend!r} not in ('jax', 'numpy')")
         for pat_name in ("umi_fwd", "umi_rev"):
             pat = getattr(self, pat_name)
             if not pat or any(c not in "ACGTUNRYSWKMBDHV" for c in pat.upper()):
