@@ -87,9 +87,16 @@ def cluster_umis(
     if U == 1:
         ulabels = np.zeros(1, np.int32)
         centroids = np.array([0], np.int32)
+    elif U <= _FULL_MATRIX_MAX:
+        # small sets (the per-region round-2 dedup case): ONE device dispatch
+        # computes the full identity matrix — exact (no shortlist, so no
+        # merge-repair pass) and ~6x fewer dispatches, which dominates cost
+        # at this size
+        neigh_idx, neigh_ident = _full_identities(codes, lens)
+        ulabels, centroids = _greedy_assign(order, neigh_idx, neigh_ident, identity_threshold)
     else:
         neigh_idx, neigh_ident = _neighbor_identities(
-            codes, lens, shortlist_k=min(shortlist_k, U - 1), kmer_k=kmer_k,
+            codes, lens, shortlist_k=shortlist_k, kmer_k=kmer_k,
             pair_batch=pair_batch,
         )
         ulabels, centroids = _greedy_assign(order, neigh_idx, neigh_ident, identity_threshold)
@@ -112,32 +119,90 @@ def cluster_umis(
     )
 
 
-def _neighbor_identities(codes, lens, shortlist_k, kmer_k, pair_batch):
-    """(U, K) nearest-unique shortlist + exact identities, device-computed."""
+_PAIR_CHUNK = 8192  # fixed device-dispatch shape for the exact-distance pass
+_FULL_MATRIX_MAX = 64  # below this, one full-matrix dispatch beats shortlists
+
+
+def _full_identities(codes, lens):
+    """All-vs-all identities in one device dispatch (U <= _FULL_MATRIX_MAX).
+
+    Returns (neigh (U, U-1), ident (U, U-1)): every other unique as a
+    "neighbor", so :func:`_greedy_assign` sees the complete identity graph.
+    U is padded to a power of two (16/32/64), bounding the kernel at three
+    compile classes.
+    """
     U = codes.shape[0]
+    U_pad = _pow2_ceil(U)
+    if U_pad > U:
+        codes = np.concatenate(
+            [codes, np.zeros((U_pad - U, codes.shape[1]), codes.dtype)]
+        )
+        lens = np.concatenate([lens, np.zeros(U_pad - U, lens.dtype)])
+    d = np.asarray(
+        edit_distance.many_vs_many_dovetail(codes, lens, codes, lens)
+    ).astype(np.float32)[:U, :U]
+    longest = np.maximum(lens[:U, None], lens[None, :U]).astype(np.float32)
+    ident = 1.0 - d / np.maximum(longest, 1.0)
+    cols = np.arange(U - 1)[None, :]
+    rows = np.arange(U)[:, None]
+    neigh = (cols + (cols >= rows)).astype(np.int32)  # skip the diagonal
+    return neigh, np.take_along_axis(ident, neigh, axis=1)
+
+
+def _pow2_ceil(n: int, lo: int = 16) -> int:
+    from ont_tcrconsensus_tpu.io.bucketing import pow2_ceil
+
+    return pow2_ceil(n, lo)
+
+
+def _neighbor_identities(codes, lens, shortlist_k, kmer_k, pair_batch):
+    """(U, K) nearest-unique shortlist + exact identities, device-computed.
+
+    Every device call runs on power-of-two padded shapes (U padded with
+    zero-length rows, the pair list padded to ``_PAIR_CHUNK`` multiples), so
+    the jitted kernels compile once per size class instead of once per
+    region/group cardinality — the UMI stage is called hundreds of times per
+    library with different U. Padded rows are harmless by construction:
+    zero profiles score 0 in the dot-product ranking (``lax.top_k`` ties
+    prefer lower = real indices), and their identities are forced to -1
+    below so they never produce graph edges.
+    """
+    U = codes.shape[0]
+    U_pad = _pow2_ceil(U)
+    K = min(shortlist_k, U_pad - 1)
+    if U_pad > U:
+        codes = np.concatenate(
+            [codes, np.zeros((U_pad - U, codes.shape[1]), codes.dtype)]
+        )
+        lens = np.concatenate([lens, np.zeros(U_pad - U, lens.dtype)])
     profiles = np.asarray(sketch.kmer_profile(codes, lens, k=kmer_k, dim=None))
-    # tiled top-(k+1) against all uniques; drop the self column vectorized:
+    # tiled top-(K+1) against all uniques; drop the self column vectorized:
     # each row holds at most one self hit, so skipping its position (or the
-    # trailing extra column when absent) leaves exactly shortlist_k entries
-    neigh = np.zeros((U, shortlist_k), dtype=np.int32)
-    tile = max(1, min(4096, U))
-    for s in range(0, U, tile):
-        e = min(s + tile, U)
-        idx = np.asarray(sketch.top_candidates(profiles[s:e], profiles, shortlist_k + 1))
+    # trailing extra column when absent) leaves exactly K entries
+    neigh = np.zeros((U_pad, K), dtype=np.int32)
+    tile = max(1, min(4096, U_pad))
+    for s in range(0, U_pad, tile):
+        e = min(s + tile, U_pad)
+        idx = np.asarray(sketch.top_candidates(profiles[s:e], profiles, K + 1))
         rows = np.arange(s, e)[:, None]
         is_self = idx == rows
-        self_pos = np.where(
-            is_self.any(axis=1), is_self.argmax(axis=1), shortlist_k
-        )[:, None]
-        cols = np.arange(shortlist_k)[None, :]
+        self_pos = np.where(is_self.any(axis=1), is_self.argmax(axis=1), K)[:, None]
+        cols = np.arange(K)[None, :]
         cols = cols + (cols >= self_pos)
         neigh[s:e] = np.take_along_axis(idx, cols, axis=1)
-    # exact distances on the (U * K) pair list
-    qi = np.repeat(np.arange(U, dtype=np.int32), shortlist_k)
+    neigh = neigh[:U]
+    # exact distances on the (U * K) pair list, padded to full chunks
+    qi = np.repeat(np.arange(U, dtype=np.int32), K)
     ti = neigh.reshape(-1)
-    ident = np.zeros(U * shortlist_k, dtype=np.float32)
-    for s in range(0, len(qi), pair_batch):
-        sl = slice(s, min(s + pair_batch, len(qi)))
+    n_pairs = len(qi)
+    chunk = min(_PAIR_CHUNK, pair_batch)
+    n_padded = ((n_pairs + chunk - 1) // chunk) * chunk
+    if n_padded > n_pairs:
+        qi = np.concatenate([qi, np.zeros(n_padded - n_pairs, np.int32)])
+        ti = np.concatenate([ti, np.zeros(n_padded - n_pairs, np.int32)])
+    ident = np.zeros(n_padded, dtype=np.float32)
+    for s in range(0, n_padded, chunk):
+        sl = slice(s, s + chunk)
         d = np.asarray(
             edit_distance.pairwise_dovetail(
                 codes[qi[sl]], lens[qi[sl]], codes[ti[sl]], lens[ti[sl]]
@@ -145,8 +210,9 @@ def _neighbor_identities(codes, lens, shortlist_k, kmer_k, pair_batch):
         ).astype(np.float32)
         longest = np.maximum(lens[qi[sl]], lens[ti[sl]]).astype(np.float32)
         ident[sl] = np.where(longest > 0, 1.0 - d / np.maximum(longest, 1.0), 0.0)
-    ident = ident.reshape(U, shortlist_k)
+    ident = ident[:n_pairs].reshape(U, K)
     ident[neigh == np.arange(U)[:, None]] = -1.0  # safety: never self-join
+    ident[neigh >= U] = -1.0  # padded rows never produce edges
     return neigh, ident
 
 
@@ -168,7 +234,7 @@ def _merge_close_centroids(labels, centroids, codes, lens, threshold,
         return labels, centroids
     ccodes, clens = codes[centroids], lens[centroids]
     neigh, ident = _neighbor_identities(
-        ccodes, clens, shortlist_k=min(shortlist_k, C - 1), kmer_k=kmer_k,
+        ccodes, clens, shortlist_k=shortlist_k, kmer_k=kmer_k,
         pair_batch=pair_batch,
     )
     parent = np.arange(C)

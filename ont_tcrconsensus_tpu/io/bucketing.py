@@ -56,6 +56,15 @@ def bucket_width(length: int, widths: Sequence[int] = DEFAULT_WIDTHS) -> int | N
     return None
 
 
+def pow2_ceil(n: int, lo: int = 1) -> int:
+    """Smallest power of two >= max(n, lo) — the shared padding-size policy
+    (bounded compile-shape classes for device batches)."""
+    p = lo
+    while p < n:
+        p *= 2
+    return p
+
+
 def batch_reads(
     records: Iterable,
     batch_size: int = 2048,

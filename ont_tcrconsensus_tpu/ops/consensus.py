@@ -182,6 +182,54 @@ def consensus_cluster(
 _vote_columns_batch = jax.jit(jax.vmap(vote_columns))
 
 
+def _extend_ends_batch(drafts, dlens, subreads, subread_lens, spans,
+                       aligned_dlens):
+    """Vectorized :func:`_extend_ends` across the cluster axis.
+
+    Args: drafts (C, W), dlens (C,), subreads (C, S, W), subread_lens (C, S),
+    spans (C, S, 4), aligned_dlens (C,). Mutates and returns (drafts, dlens).
+    Padded subread rows are excluded naturally: their spans sit far outside
+    [0, aligned_dlen] (see the traceback init), so they never count as
+    boundary-reaching.
+    """
+    C, S, W = subreads.shape
+    r_start, r_end = spans[:, :, 0], spans[:, :, 1]
+    f_start, f_end = spans[:, :, 2], spans[:, :, 3]
+
+    def vote(bases, voters):
+        votes = np.stack(
+            [((bases == code) & voters).sum(axis=1) for code in range(4)], axis=1
+        )
+        return votes.sum(axis=1) > 0, votes.argmax(axis=1).astype(np.uint8)
+
+    # left end
+    at_left = f_start == 0
+    has_more = at_left & (r_start > 0)
+    n_at, n_more = at_left.sum(axis=1), has_more.sum(axis=1)
+    idx = np.maximum(r_start - 1, 0)
+    bases = np.take_along_axis(subreads, idx[:, :, None], axis=2)[:, :, 0]
+    have, win = vote(bases, has_more)
+    do = (n_at > 0) & (n_more * 2 > n_at) & (dlens < W) & have
+    if do.any():
+        drafts[do] = np.concatenate(
+            [win[do, None], drafts[do, :-1]], axis=1
+        )
+        dlens[do] += 1
+
+    # right end (spans were computed against the pre-vote draft)
+    at_right = f_end == aligned_dlens[:, None]
+    has_more = at_right & (r_end < subread_lens)
+    n_at, n_more = at_right.sum(axis=1), has_more.sum(axis=1)
+    idx = np.minimum(r_end, W - 1)
+    bases = np.take_along_axis(subreads, idx[:, :, None], axis=2)[:, :, 0]
+    have, win = vote(bases, has_more)
+    do = (n_at > 0) & (n_more * 2 > n_at) & (dlens < W) & have
+    if do.any():
+        drafts[do, dlens[do]] = win[do]
+        dlens[do] += 1
+    return drafts, dlens
+
+
 def consensus_clusters_batch(
     subreads: np.ndarray,
     subread_lens: np.ndarray,
@@ -220,25 +268,24 @@ def consensus_clusters_batch(
         new_drafts, new_lens = _vote_columns_batch(
             base_at, ins_cnt, ins_base, jnp.asarray(drafts), jnp.asarray(dlens)
         )
-        new_drafts = np.asarray(new_drafts)[:, :W]
-        new_lens = np.asarray(new_lens)
+        new_drafts = np.asarray(new_drafts)[:, :W].copy()
+        new_lens = np.asarray(new_lens).astype(np.int32).copy()
         spans = np.asarray(spans)
-        all_unchanged = True
-        for c in range(C):
-            if dlens[c] == 0:
-                continue
-            if int(new_lens[c]) > W:
-                raise ValueError("consensus grew past the padded width")
-            cand = np.full((W,), PAD_CODE, np.uint8)
-            cand[:W] = new_drafts[c]
-            cand, nl = _extend_ends(
-                cand, int(new_lens[c]), subreads[c], subread_lens[c], spans[c],
-                int(dlens[c]),
-            )
-            unchanged = nl == dlens[c] and (cand[:nl] == drafts[c, :nl]).all()
-            drafts[c] = cand
-            dlens[c] = nl
-            all_unchanged &= bool(unchanged)
+        live = dlens > 0
+        if (new_lens[live] > W).any():
+            raise ValueError("consensus grew past the padded width")
+        # empty clusters keep their (empty) draft
+        new_drafts[~live] = drafts[~live]
+        new_lens[~live] = dlens[~live]
+        new_drafts, new_lens = _extend_ends_batch(
+            new_drafts, new_lens, subreads, subread_lens, spans, dlens
+        )
+        # vote output + extensions keep PAD beyond new_lens by construction,
+        # so whole-row equality == content equality up to the lengths
+        all_unchanged = bool(
+            (new_lens == dlens).all() and (new_drafts == drafts).all()
+        )
+        drafts, dlens = new_drafts, new_lens
         if all_unchanged:
             break
     return drafts, dlens

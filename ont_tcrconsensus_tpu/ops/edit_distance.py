@@ -146,4 +146,18 @@ def pairwise_dovetail(a, a_lens, b, b_lens, k_end: int = 8):
     )
 
 
+@jax.jit
+def many_vs_many_dovetail(queries, q_lens, targets, t_lens, k_end: int = 8):
+    """(Q, L) x (T, L) -> (Q, T) budgeted-dovetail distance matrix."""
+    q_lens = q_lens.astype(jnp.int32)
+    t_lens = t_lens.astype(jnp.int32)
+
+    def one_q(q, ql):
+        return jax.vmap(lambda t, tl: _dovetail_pair(q, ql, t, tl, k_end))(
+            targets, t_lens
+        )
+
+    return jax.vmap(one_q)(queries, q_lens)
+
+
 # k-mer profile prefilters live in :mod:`.sketch` (exact mode: dim=None).

@@ -89,4 +89,4 @@ class BudgetModel:
     def cluster_batch(self, s_bucket: int, width: int,
                       band_width: int = 128) -> int:
         per = self.cluster_bytes(s_bucket, width, band_width)
-        return _pow2_floor(self.budget_bytes // per, 1, 64)
+        return _pow2_floor(self.budget_bytes // per, 1, 256)

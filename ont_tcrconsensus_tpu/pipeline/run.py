@@ -311,22 +311,44 @@ def _run_library(fastq, lay, cfg, panel, engine, engine_notrim,
         ),
     )
 
-    # round 1: UMI cluster / select / consensus, per region cluster.
-    # A poisoned group degrades gracefully: it is skipped AND reported, the
-    # rest of the library completes (the reference behaves the same way for
-    # failed medaka batches, tcr_consensus.py:329-346).
-    merged_consensus: list[tuple[str, str]] = []
+    # round 1: UMI cluster + subread selection per region cluster, then ONE
+    # library-wide batched consensus polish over every group's clusters
+    # (stages.polish_clusters_all). A poisoned group degrades gracefully: it
+    # is skipped AND reported, the rest of the library completes (the
+    # reference behaves the same way for failed medaka batches,
+    # tcr_consensus.py:329-346).
+    selected_by_group: list[tuple[str, list[stages.SelectedCluster]]] = []
     failed_groups: list[tuple[str, str]] = []
     for cluster_key in sorted(groups):
         group_name = f"region_cluster{cluster_key}"
         try:
-            merged_consensus.extend(_round1_group(
-                group_name, groups[cluster_key], store, lay, cfg,
-                polisher, budget, timer, library,
-            ))
+            sel = _round1_select(
+                group_name, groups[cluster_key], store, lay, cfg, timer,
+            )
+            if sel:
+                selected_by_group.append((group_name, sel))
         except Exception as exc:
             failed_groups.append((group_name, repr(exc)))
             _log(f"WARNING: {group_name} failed and is skipped: {exc!r}")
+    n_clusters = sum(len(s) for _, s in selected_by_group)
+    _log(f"Polishing clusters: {library} "
+         f"({n_clusters} clusters over {len(selected_by_group)} region clusters)")
+    with timer.stage("round1_polish"):
+        by_group, polish_failed = stages.polish_clusters_all(
+            selected_by_group, store,
+            max_read_length=cfg.max_read_length,
+            polisher=polisher,
+            budget=budget,
+            cluster_batch=cfg.cluster_batch_size,
+        )
+    merged_consensus: list[tuple[str, str]] = []
+    for group_name, _ in selected_by_group:
+        if group_name in polish_failed:
+            failed_groups.append((group_name, polish_failed[group_name]))
+            _log(f"WARNING: {group_name} polish failed and is skipped: "
+                 f"{polish_failed[group_name]}")
+        else:
+            merged_consensus.extend(by_group[group_name])
     if failed_groups:
         _log(
             "Not all umi cluster region fastas were successfully polished! "
@@ -347,9 +369,10 @@ def _run_library(fastq, lay, cfg, panel, engine, engine_notrim,
                        round1_complete=not failed_groups)
 
 
-def _round1_group(group_name, parts, store, lay, cfg, polisher, budget,
-                  timer, library) -> list[tuple[str, str]]:
-    """UMI cluster -> subread select -> consensus for one region cluster."""
+def _round1_select(group_name, parts, store, lay, cfg,
+                   timer) -> list[stages.SelectedCluster]:
+    """UMI cluster -> subread select for one region cluster (polish is
+    batched library-wide afterwards, stages.polish_clusters_all)."""
     with timer.stage("round1_umi_records"):
         umis = stages.build_umi_records(store, parts, cfg.max_pattern_dist)
     if not umis:
@@ -374,17 +397,7 @@ def _round1_group(group_name, parts, store, lay, cfg, polisher, budget,
     stages.write_cluster_stats_tsv(
         stat_rows, os.path.join(cdir, "vsearch_cluster_stats.tsv")
     )
-    if not selected:
-        return []
-    _log("Polishing clusters:", library, group_name, f"({len(selected)} clusters)")
-    with timer.stage("round1_polish"):
-        return stages.polish_clusters_stage(
-            selected, group_name, store,
-            max_read_length=cfg.max_read_length,
-            polisher=polisher,
-            budget=budget,
-            cluster_batch=cfg.cluster_batch_size,
-        )
+    return selected
 
 
 def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,

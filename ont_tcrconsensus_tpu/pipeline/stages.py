@@ -249,9 +249,8 @@ def write_cluster_stats_tsv(stat_rows: list[dict], path: str) -> None:
 # stage: consensus polishing (medaka smolecule replacement)
 
 
-def polish_clusters_stage(
-    selected: list[SelectedCluster],
-    group_name: str,
+def polish_clusters_all(
+    selected_by_group: list[tuple[str, list[SelectedCluster]]],
     store: ReadStore,
     max_read_length: int = 4096,
     rounds: int = 4,
@@ -259,11 +258,16 @@ def polish_clusters_stage(
     polisher=None,
     cluster_batch: int | None = None,
     budget=None,
-) -> list[tuple[str, str]]:
-    """Consensus per selected cluster; returns (header, sequence) pairs.
+) -> tuple[dict[str, list[tuple[str, str]]], dict[str, str]]:
+    """Consensus for every selected cluster of every group, batched together.
 
-    Headers follow the reference's rewrite
+    The reference polishes per region-cluster task (medaka_polish.py:95-144);
+    a TPU chip wants the opposite — ONE large device batch per tile shape
+    across the whole library, so the (C, S, W) pileup kernels run with a full
+    cluster axis instead of dozens of per-group slivers (and compile once per
+    shape, not once per group). Headers follow the reference's rewrite
     ``<group>_<clusterN>_<n_subreads>`` (medaka_polish.py:146-180).
+
     Subreads are gathered from the columnar store and flipped to canonical
     (+) orientation (strand is known from alignment — unlike medaka, no
     internal re-orientation pass).
@@ -271,43 +275,56 @@ def polish_clusters_stage(
     Static-shape discipline: clusters are grouped by (subread-count bucket,
     width bucket) and processed in batches of ``cluster_batch`` through one
     device dispatch per round (``consensus_clusters_batch``); the optional
-    ``polisher`` is called ONCE per chunk on the whole (C, S, W) tile
-    (medaka_polish.py:95-144 analogue, batched across clusters).
+    ``polisher`` is called ONCE per chunk on the whole (C, S, W) tile.
     Padding rows have length 0: they score 0 and cast no votes.
+
+    Returns ``(consensus_by_group, failed_groups)``: per-group (header, seq)
+    lists in cluster-id order, and {group: error} for groups hit by a failed
+    device chunk (the per-task degradation of tcr_consensus.py:329-346 —
+    peers in the same chunk share the failure, every other chunk completes).
     """
-    prepared: dict[tuple[int, int], list[tuple[SelectedCluster, np.ndarray, np.ndarray]]] = (
+    prepared: dict[tuple[int, int], list[tuple[str, SelectedCluster, np.ndarray, np.ndarray]]] = (
         defaultdict(list)
     )
-    for cl in selected:
-        rows_codes = []
-        max_len = 0
-        for m in cl.members:
-            blk = store.blocks[m.block]
-            ln = int(blk.lens[m.row])
-            c = blk.codes[m.row, :ln]
-            if m.strand == "-":
-                c = encode.revcomp_codes(c)
-            rows_codes.append(c)
-            max_len = max(max_len, ln)
-        # one lane-width of growth slack above the longest subread
-        need = max_len + 128
-        width = min(
-            max_read_length,
-            next((w for w in bucketing.DEFAULT_WIDTHS if w >= need), max_read_length),
-        )
-        codes, lens = encode.pad_batch(rows_codes, pad_to=width, multiple=128)
-        s_bucket = 1
-        while s_bucket < len(rows_codes):
-            s_bucket *= 2
-        if s_bucket > len(rows_codes):
-            pad_rows = s_bucket - len(rows_codes)
-            codes = np.concatenate(
-                [codes, np.full((pad_rows, codes.shape[1]), encode.PAD_CODE, np.uint8)]
-            )
-            lens = np.concatenate([lens, np.zeros(pad_rows, lens.dtype)])
-        prepared[(s_bucket, codes.shape[1])].append((cl, codes, lens))
-
-    out: list[tuple[str, str]] = []
+    by_group: dict[str, list[tuple[str, str]]] = {g: [] for g, _ in selected_by_group}
+    failed: dict[str, str] = {}
+    for group_name, selected in selected_by_group:
+        # the gather phase degrades per group like the device chunks below: a
+        # poisoned cluster (oversized member, corrupt handle) fails only its
+        # own group (ref tcr_consensus.py:329-346 semantics)
+        try:
+            group_prepared = []
+            for cl in selected:
+                rows_codes = []
+                max_len = 0
+                for m in cl.members:
+                    blk = store.blocks[m.block]
+                    ln = int(blk.lens[m.row])
+                    c = blk.codes[m.row, :ln]
+                    if m.strand == "-":
+                        c = encode.revcomp_codes(c)
+                    rows_codes.append(c)
+                    max_len = max(max_len, ln)
+                # one lane-width of growth slack above the longest subread
+                need = max_len + 128
+                width = min(
+                    max_read_length,
+                    next((w for w in bucketing.DEFAULT_WIDTHS if w >= need), max_read_length),
+                )
+                codes, lens = encode.pad_batch(rows_codes, pad_to=width, multiple=128)
+                s_bucket = bucketing.pow2_ceil(len(rows_codes))
+                if s_bucket > len(rows_codes):
+                    pad_rows = s_bucket - len(rows_codes)
+                    codes = np.concatenate(
+                        [codes, np.full((pad_rows, codes.shape[1]), encode.PAD_CODE, np.uint8)]
+                    )
+                    lens = np.concatenate([lens, np.zeros(pad_rows, lens.dtype)])
+                group_prepared.append((s_bucket, codes.shape[1], cl, codes, lens))
+        except Exception as exc:
+            failed[group_name] = repr(exc)
+            continue
+        for s_bucket, width, cl, codes, lens in group_prepared:
+            prepared[(s_bucket, width)].append((group_name, cl, codes, lens))
     for (s_bucket, width), items in sorted(prepared.items()):
         # cluster-tile batch from the HBM budget (the medaka memory-model
         # analogue, parallel/budget.py) unless explicitly overridden
@@ -317,30 +334,55 @@ def polish_clusters_stage(
             cb = budget.cluster_batch(s_bucket, width, band_width)
         else:
             cb = 16
+        # never pad the cluster axis past the work available (a small
+        # library padded to the full HBM tile wastes most of the dispatch);
+        # power-of-two so compile shapes stay bounded
+        cb = min(cb, bucketing.pow2_ceil(len(items)))
         for start in range(0, len(items), cb):
             chunk = items[start : start + cb]
             C = len(chunk)
-            sub = np.stack([codes for _, codes, _ in chunk])
-            lens = np.stack([ln for _, _, ln in chunk])
-            if C < cb:  # pad the cluster axis: stable compile shapes
-                pad = cb - C
-                sub = np.concatenate(
-                    [sub, np.full((pad, s_bucket, width), encode.PAD_CODE, np.uint8)]
+            try:
+                sub = np.stack([codes for _, _, codes, _ in chunk])
+                lens = np.stack([ln for _, _, _, ln in chunk])
+                if C < cb:  # pad the cluster axis: stable compile shapes
+                    pad = cb - C
+                    sub = np.concatenate(
+                        [sub, np.full((pad, s_bucket, width), encode.PAD_CODE, np.uint8)]
+                    )
+                    lens = np.concatenate([lens, np.zeros((pad, s_bucket), lens.dtype)])
+                drafts, dlens = consensus_mod.consensus_clusters_batch(
+                    sub, lens, rounds=rounds, band_width=band_width
                 )
-                lens = np.concatenate([lens, np.zeros((pad, s_bucket), lens.dtype)])
-            drafts, dlens = consensus_mod.consensus_clusters_batch(
-                sub, lens, rounds=rounds, band_width=band_width
-            )
-            if polisher is not None:
-                drafts, dlens = polisher(sub, lens, drafts, dlens)
-            seqs = encode.decode_batch(drafts[:C], dlens[:C])
+                if polisher is not None:
+                    drafts, dlens = polisher(sub, lens, drafts, dlens)
+                seqs = encode.decode_batch(drafts[:C], dlens[:C])
+            except Exception as exc:
+                for group_name, _, _, _ in chunk:
+                    failed.setdefault(group_name, repr(exc))
+                continue
             for c in range(C):
-                cl = chunk[c][0]
-                out.append(
+                group_name, cl = chunk[c][0], chunk[c][1]
+                by_group[group_name].append(
                     (f"{group_name}_cluster{cl.cluster_id}_{len(cl.members)}", seqs[c])
                 )
-    out.sort(key=lambda kv: int(kv[0].rsplit("_cluster", 1)[1].split("_")[0]))
-    return out
+    for entries in by_group.values():
+        entries.sort(key=lambda kv: int(kv[0].rsplit("_cluster", 1)[1].split("_")[0]))
+    return by_group, failed
+
+
+def polish_clusters_stage(
+    selected: list[SelectedCluster],
+    group_name: str,
+    store: ReadStore,
+    **kwargs,
+) -> list[tuple[str, str]]:
+    """Single-group convenience wrapper over :func:`polish_clusters_all`."""
+    by_group, failed = polish_clusters_all(
+        [(group_name, selected)], store, **kwargs
+    )
+    if failed:
+        raise RuntimeError(f"polish failed for {group_name}: {failed[group_name]}")
+    return by_group[group_name]
 
 
 # ---------------------------------------------------------------------------

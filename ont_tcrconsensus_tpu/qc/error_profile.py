@@ -271,7 +271,7 @@ def banded_cs_batch(queries: list[np.ndarray], refs: list[np.ndarray],
 
 
 def profile_store(store, panel, sample_size: int = 1000, seed: int = 0,
-                  chunk: int = 128):
+                  chunk: int = 512):
     """cs-tag counters over a read-store sample.
 
     Returns (tag_counter, tag->region counter, tag->blast_id counter) — the

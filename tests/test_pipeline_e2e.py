@@ -194,15 +194,27 @@ def test_pipeline_degrades_gracefully_on_poisoned_group(sim_library, tmp_path, m
     shutil.copytree(tmp / "fastq_pass" / "barcode01", root / "fastq_pass" / "barcode01")
     shutil.copy(tmp / "reference.fa", root / "reference.fa")
 
-    real_polish = stages.polish_clusters_stage
+    real_polish = stages.polish_clusters_all
     poisoned = "region_cluster0"
 
-    def flaky_polish(selected, group_name, store, **kw):
-        if group_name == poisoned:
+    def flaky_polish(selected_by_group, store, **kw):
+        # poison the device chunks that contain the target group: the
+        # library-wide batcher must fail ONLY the chunk's groups and
+        # complete every other chunk (its per-chunk try/except)
+        def poison_polisher(sub, lens, drafts, dlens):
             raise RuntimeError("injected failure")
-        return real_polish(selected, group_name, store, **kw)
 
-    monkeypatch.setattr(stages, "polish_clusters_stage", flaky_polish)
+        ok_groups = [(g, s) for g, s in selected_by_group if g != poisoned]
+        bad_groups = [(g, s) for g, s in selected_by_group if g == poisoned]
+        by_group, failed = real_polish(ok_groups, store, **kw)
+        kw_bad = dict(kw, polisher=poison_polisher)
+        bad_by_group, bad_failed = real_polish(bad_groups, store, **kw_bad)
+        assert poisoned in bad_failed, "chunk failure did not mark the group"
+        by_group.update(bad_by_group)
+        failed.update(bad_failed)
+        return by_group, failed
+
+    monkeypatch.setattr(stages, "polish_clusters_all", flaky_polish)
     cfg = RunConfig.from_dict({
         "reference_file": str(root / "reference.fa"),
         "fastq_pass_dir": str(root / "fastq_pass"),
