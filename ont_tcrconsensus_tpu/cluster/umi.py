@@ -120,7 +120,13 @@ def cluster_umis(
 
 
 _PAIR_CHUNK = 8192  # fixed device-dispatch shape for the exact-distance pass
-_FULL_MATRIX_MAX = 64  # below this, one full-matrix dispatch beats shortlists
+# Below this, ONE full-matrix dispatch beats the shortlist path's ~7 device
+# round-trips: at U_pad=256 the (U_pad, U_pad) dovetail DP is 65k parallel
+# lanes x 128 scan steps — milliseconds of well-shaped TPU work, vs hundreds
+# of ms of dispatch latency for profile+topk+pairs+merge. Typical per-group
+# UMI sets (round 1: ~one unique UMI per read in the group; round 2: one per
+# molecule) sit well under this.
+_FULL_MATRIX_MAX = 256
 
 
 def _full_identities(codes, lens):
@@ -128,7 +134,7 @@ def _full_identities(codes, lens):
 
     Returns (neigh (U, U-1), ident (U, U-1)): every other unique as a
     "neighbor", so :func:`_greedy_assign` sees the complete identity graph.
-    U is padded to a power of two (16/32/64), bounding the kernel at three
+    U is padded to a power of two (16..256), bounding the kernel at five
     compile classes.
     """
     U = codes.shape[0]
@@ -233,10 +239,13 @@ def _merge_close_centroids(labels, centroids, codes, lens, threshold,
     if C <= 1:
         return labels, centroids
     ccodes, clens = codes[centroids], lens[centroids]
-    neigh, ident = _neighbor_identities(
-        ccodes, clens, shortlist_k=shortlist_k, kmer_k=kmer_k,
-        pair_batch=pair_batch,
-    )
+    if C <= _FULL_MATRIX_MAX:
+        neigh, ident = _full_identities(ccodes, clens)
+    else:
+        neigh, ident = _neighbor_identities(
+            ccodes, clens, shortlist_k=shortlist_k, kmer_k=kmer_k,
+            pair_batch=pair_batch,
+        )
     parent = np.arange(C)
 
     def find(x: int) -> int:

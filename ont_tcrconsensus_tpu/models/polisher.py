@@ -135,7 +135,7 @@ _device_polish_batch_jit = jax.jit(
 
 def make_pipeline_polisher(params, band_width: int = 128,
                            min_confidence: float = 0.9):
-    """Adapter for ``stages.polish_clusters_stage(polisher=...)``.
+    """Adapter for ``stages.polish_clusters_all(polisher=...)``.
 
     Returns f(sub (C,S,W), lens (C,S), drafts (C,W), dlens (C,)) ->
     (polished (C,W), polished_lens (C,)): one device dispatch per cluster
@@ -144,13 +144,10 @@ def make_pipeline_polisher(params, band_width: int = 128,
     from ont_tcrconsensus_tpu.ops.encode import PAD_CODE
 
     def polish(sub, lens, drafts, dlens):
-        pred, conf, depth = _device_polish_batch_jit(
+        pred, conf, depth = jax.device_get(_device_polish_batch_jit(
             params, jnp.asarray(sub), jnp.asarray(lens),
             jnp.asarray(drafts), jnp.asarray(dlens), band_width,
-        )
-        pred = np.asarray(pred)
-        conf = np.asarray(conf)
-        depth = np.asarray(depth)
+        ))
         drafts = np.asarray(drafts)
         dlens = np.asarray(dlens)
         C, W = drafts.shape

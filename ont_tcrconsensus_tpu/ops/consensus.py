@@ -268,9 +268,11 @@ def consensus_clusters_batch(
         new_drafts, new_lens = _vote_columns_batch(
             base_at, ins_cnt, ins_base, jnp.asarray(drafts), jnp.asarray(dlens)
         )
-        new_drafts = np.asarray(new_drafts)[:, :W].copy()
-        new_lens = np.asarray(new_lens).astype(np.int32).copy()
-        spans = np.asarray(spans)
+        # one coalesced device->host transfer (per-array readback pays a
+        # flat round-trip each; decisive over a tunneled TPU)
+        new_drafts, new_lens, spans = jax.device_get((new_drafts, new_lens, spans))
+        new_drafts = new_drafts[:, :W].copy()
+        new_lens = new_lens.astype(np.int32).copy()
         live = dlens > 0
         if (new_lens[live] > W).any():
             raise ValueError("consensus grew past the padded width")

@@ -47,3 +47,27 @@ def ee_rate_mask(
     ee = expected_errors(quals, lengths)
     lens = jnp.maximum(lengths, 1).astype(jnp.float32)
     return (ee / lens <= max_ee_rate) & (lengths >= min_len)
+
+
+@jax.jit
+def ee_rate_mask_span(
+    quals: jax.Array,
+    t_start: jax.Array,
+    t_end: jax.Array,
+    max_ee_rate: jax.Array | float,
+    min_len: jax.Array | int,
+) -> jax.Array:
+    """:func:`ee_rate_mask` over the [t_start, t_end) span of each read.
+
+    Lets the fused pass filter on post-trim quality without materializing
+    shifted quality arrays (the trim is virtual: reads stay unshifted on
+    device, only the span bounds move).
+    """
+    q = quals.astype(jnp.float32)
+    pos = jnp.arange(q.shape[1], dtype=jnp.int32)[None, :]
+    in_span = (pos >= t_start[:, None]) & (pos < t_end[:, None])
+    ee = jnp.sum(jnp.where(in_span, jnp.power(10.0, -q / 10.0), 0.0), axis=1)
+    lens = t_end - t_start
+    return (ee / jnp.maximum(lens, 1).astype(jnp.float32) <= max_ee_rate) & (
+        lens >= min_len
+    )

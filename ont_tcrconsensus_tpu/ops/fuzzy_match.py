@@ -49,31 +49,38 @@ def _column_step(col, text_char, pattern_mask):
     return jnp.minimum(base, cascaded)
 
 
-def _final_row(pattern_mask: jax.Array, window: jax.Array) -> jax.Array:
+def _final_row(pattern_mask: jax.Array, window: jax.Array,
+               pattern_len: jax.Array | None = None) -> jax.Array:
     """Distance of pattern vs best substring ending at each text position.
 
     Returns (L+1,) int32: entry j = min edit distance over substrings of
     window[:j] that end exactly at j (0 = empty prefix => distance m).
+
+    ``pattern_len`` supports padded patterns (mask rows past the true length
+    are ignored): DP row i only reads rows <= i, so reading the final row at
+    ``pattern_len`` instead of m is exact.
     """
     m = pattern_mask.shape[0]
+    p_len = jnp.int32(m) if pattern_len is None else pattern_len.astype(jnp.int32)
     init = jnp.arange(m + 1, dtype=jnp.int32)
 
     def step(col, ch):
         new = _column_step(col, ch, pattern_mask)
-        return new, new[m]
+        return new, new[p_len]
 
     _, tail = jax.lax.scan(step, init, window)
-    return jnp.concatenate([jnp.array([m], jnp.int32), tail])
+    return jnp.concatenate([p_len[None], tail])
 
 
-def _find_one(pattern_mask, rev_pattern_mask, window, window_len):
+def _find_one(pattern_mask, rev_pattern_mask, window, window_len,
+              pattern_len=None):
     """(dist, start, end_exclusive) for one window.
 
     An empty window yields dist=m (the whole pattern deleted) — always above
     any sane k threshold, so callers' ``dist <= k`` gate rejects it.
     """
     L = window.shape[0]
-    row = _final_row(pattern_mask, window)  # (L+1,)
+    row = _final_row(pattern_mask, window, pattern_len)  # (L+1,)
     j = jnp.arange(L + 1, dtype=jnp.int32)
     valid = j <= window_len
     masked = jnp.where(valid, row, BIG)
@@ -86,12 +93,50 @@ def _find_one(pattern_mask, rev_pattern_mask, window, window_len):
     r = jnp.arange(L, dtype=jnp.int32)
     src = jnp.clip(end - 1 - r, 0, L - 1)
     rev_prefix = jnp.where(r < end, window[src], jnp.uint8(0))
-    rrow = _final_row(rev_pattern_mask, rev_prefix)
+    rrow = _final_row(rev_pattern_mask, rev_prefix, pattern_len)
     rvalid = j <= end
     hits = rvalid & (rrow == dist)
     j2 = jnp.max(jnp.where(hits, j, -1))
     start = end - j2
     return dist, start, end
+
+
+@jax.jit
+def fuzzy_find_multi(
+    pattern_masks: jax.Array,
+    pattern_lens: jax.Array,
+    windows: jax.Array,
+    window_lens: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Multi-pattern batched infix fuzzy match — ONE device dispatch.
+
+    Args:
+      pattern_masks: (P, m) uint8 IUPAC masks, zero-padded past each true
+        length; pattern_lens: (P,) int32.
+      windows: (B, L) uint8 mask windows; window_lens: (B,) int32.
+
+    Returns (dist, start, end), each (P, B) int32 — the per-pattern results
+    of :func:`fuzzy_find`. Stacking patterns widens the per-step DP tensor
+    instead of multiplying dispatches: the scan is latency-bound at
+    realistic (B, m), so P patterns cost ~the same wall time as one.
+    """
+    m = pattern_masks.shape[1]
+    idx = jnp.arange(m, dtype=jnp.int32)
+    p_lens = pattern_lens.astype(jnp.int32)
+    # reverse each pattern within its true length (padding stays at the tail)
+    src = jnp.clip(p_lens[:, None] - 1 - idx[None, :], 0, m - 1)
+    revs = jnp.where(
+        idx[None, :] < p_lens[:, None],
+        jnp.take_along_axis(pattern_masks, src, axis=1),
+        jnp.uint8(0),
+    )
+
+    def one_pattern(pm, rev, p_len):
+        return jax.vmap(lambda w, n: _find_one(pm, rev, w, n, p_len))(
+            windows, window_lens.astype(jnp.int32)
+        )
+
+    return jax.vmap(one_pattern)(pattern_masks, revs, p_lens)
 
 
 @jax.jit

@@ -37,6 +37,7 @@ from ont_tcrconsensus_tpu.io import bucketing, fastx
 from ont_tcrconsensus_tpu.ops import ee_filter, encode, fuzzy_match, sketch, sw_pallas
 
 MIN_SCORE = 100  # SW score gate for a "primary alignment" equivalent
+BIG_DIST = 1 << 20  # sentinel distance for "no qualifying primer hit"
 
 
 # ---------------------------------------------------------------------------
@@ -97,8 +98,8 @@ class ReferencePanel:
 def _fused_pass(
     codes, quals, lens,
     ref_codes, ref_lens, ref_profiles,
-    umi_fwd_mask, umi_rev_mask,
-    primer_masks, primer_rc_masks, primer_max_dists,
+    umi_masks, umi_mask_lens,
+    primer_stack, primer_stack_lens, primer_max_dists,
     max_ee_rate, min_len,
     *,
     top_k: int, band_width: int, a5: int, a3: int,
@@ -107,8 +108,12 @@ def _fused_pass(
     """One device dispatch: trim + filter + assign + UMI-locate a batch.
 
     All inputs are padded device arrays; every output is a (B,)-shaped array
-    except the trimmed codes/quals. ``primer_masks`` is a tuple of per-primer
-    IUPAC mask arrays (static count/lengths via ``primer_shapes``).
+    except the trimmed codes/quals. ``primer_stack`` is (2P, m) — P forward
+    primers then their P reverse complements, zero-padded (static count via
+    ``primer_shapes``); ``umi_masks`` is (2, m_umi) — fwd then rev pattern.
+    Pattern searches run as single multi-pattern dispatches over the
+    concatenated 5'/3' windows: the DP scan is latency-bound, so stacked
+    patterns/windows are ~free while per-pattern calls are not.
     """
     B, W = codes.shape
     lens = lens.astype(jnp.int32)
@@ -117,73 +122,64 @@ def _fused_pass(
     t_start = jnp.zeros((B,), jnp.int32)
     t_end = lens
     if primer_shapes:
+        P = len(primer_shapes)
         tw = min(trim_window, W)
         pos = jnp.arange(tw, dtype=jnp.int32)[None, :]
-        # 5' window: all primers, forward orientation
+        # 5' window (forward primers) + 3' window (RC primers), one dispatch
         w5 = jnp.take(jnp.asarray(encode.CODE_TO_MASK), codes[:, :tw].astype(jnp.int32))
-        w5_len = jnp.minimum(lens, tw)
-        best_d5 = jnp.full((B,), 1 << 20, jnp.int32)
-        best_e5 = jnp.zeros((B,), jnp.int32)
-        hit5 = jnp.zeros((B,), bool)
-        # 3' window: reverse-complemented primers
         start3w = jnp.maximum(lens - tw, 0)
         idx3 = jnp.clip(start3w[:, None] + pos, 0, W - 1)
         w3 = jnp.take(jnp.asarray(encode.CODE_TO_MASK),
                       jnp.take_along_axis(codes, idx3, axis=1).astype(jnp.int32))
-        w3_len = jnp.minimum(lens, tw)
-        best_d3 = jnp.full((B,), 1 << 20, jnp.int32)
-        best_s3 = jnp.zeros((B,), jnp.int32)
-        hit3 = jnp.zeros((B,), bool)
-        for p, (pm, prc, pmax) in enumerate(
-            zip(primer_masks, primer_rc_masks, primer_max_dists)
-        ):
-            d, _, e = fuzzy_match.fuzzy_find(pm, w5, w5_len)
-            better = (d <= pmax) & (d < best_d5)
-            best_d5 = jnp.where(better, d, best_d5)
-            best_e5 = jnp.where(better, e, best_e5)
-            hit5 = hit5 | better
-            d, s, _ = fuzzy_match.fuzzy_find(prc, w3, w3_len)
-            better = (d <= pmax) & (d < best_d3)
-            best_d3 = jnp.where(better, d, best_d3)
-            best_s3 = jnp.where(better, s, best_s3)
-            hit3 = hit3 | better
+        wlen = jnp.minimum(lens, tw)
+        wins = jnp.concatenate([w5, w3], axis=0)          # (2B, tw)
+        wlens = jnp.concatenate([wlen, wlen], axis=0)
+        d, s, e = fuzzy_match.fuzzy_find_multi(
+            primer_stack, primer_stack_lens, wins, wlens
+        )  # each (2P, 2B)
+        pmax = primer_max_dists[:, None]
+        # loop-equivalent selection: among qualifying primers the smallest
+        # distance wins, ties to the earliest primer (argmin is first-min)
+        d5p = jnp.where(d[:P, :B] <= pmax, d[:P, :B], jnp.int32(BIG_DIST))
+        p5 = jnp.argmin(d5p, axis=0)
+        hit5 = jnp.take_along_axis(d5p, p5[None, :], axis=0)[0] < BIG_DIST
+        best_e5 = jnp.take_along_axis(e[:P, :B], p5[None, :], axis=0)[0]
+        d3p = jnp.where(d[P:, B:] <= pmax, d[P:, B:], jnp.int32(BIG_DIST))
+        p3 = jnp.argmin(d3p, axis=0)
+        hit3 = jnp.take_along_axis(d3p, p3[None, :], axis=0)[0] < BIG_DIST
+        best_s3 = jnp.take_along_axis(s[P:, B:], p3[None, :], axis=0)[0]
         t_start = jnp.where(hit5, best_e5, 0)
         t_end = jnp.where(hit3, start3w + best_s3, lens)
         t_end = jnp.maximum(t_end, t_start)
 
-        # shift reads left by t_start
-        shift_idx = jnp.clip(
-            jnp.arange(W, dtype=jnp.int32)[None, :] + t_start[:, None], 0, W - 1
-        )
-        in_new = jnp.arange(W, dtype=jnp.int32)[None, :] < (t_end - t_start)[:, None]
-        codes = jnp.where(
-            in_new, jnp.take_along_axis(codes, shift_idx, axis=1),
-            jnp.uint8(encode.PAD_CODE),
-        )
-        if has_quals:
-            quals = jnp.where(
-                in_new, jnp.take_along_axis(quals, shift_idx, axis=1), jnp.uint8(93)
-            )
-        lens = (t_end - t_start).astype(jnp.int32)
+    # The trim is VIRTUAL: reads stay unshifted on device, only the
+    # [t_start, t_end) span bounds move. No (B, W) shift gathers, and —
+    # decisive over a tunneled TPU — no (B, W) codes/quals readback: the
+    # host already holds the unshifted batch and compacts survivors itself.
+    lens_t = (t_end - t_start).astype(jnp.int32)
 
     # --- EE / length filter (vsearch --fastq_filter, preprocessing.py:104-159)
     if has_quals:
-        ee_ok = ee_filter.ee_rate_mask(quals, lens, max_ee_rate, min_len)
+        ee_ok = ee_filter.ee_rate_mask_span(quals, t_start, t_end, max_ee_rate, min_len)
     else:
-        ee_ok = lens >= min_len
+        ee_ok = lens_t >= min_len
 
     # --- sketch candidates + strand (minimap2 seeding analogue) ---
+    # computed on the untrimmed read: the <=150 nt adapter/primer margin is
+    # uniform noise against a ~2 kb signal and local SW soft-clips it
     cand_idx, _, is_rev = sketch.candidates_both_strands(
         codes, lens, ref_profiles, top_k=top_k
     )
     oriented = jnp.where(is_rev[:, None], sketch.revcomp_batch(codes, lens), codes)
+    # trimmed-span start in the oriented frame (revcomp flips the span)
+    t_start_o = jnp.where(is_rev, lens - t_end, t_start)
 
     # --- banded SW vs each candidate; keep the best score ---
     best = None
     for c in range(top_k):
         ridx = cand_idx[:, c]
         rl = jnp.take(ref_lens, ridx)
-        offs = (-((lens - rl) // 2)).astype(jnp.int32)
+        offs = (-t_start_o - ((lens_t - rl) // 2)).astype(jnp.int32)
         res = sw_pallas.align_banded_auto(
             oriented, lens, jnp.take(ref_codes, ridx, axis=0), rl, offs,
             band_width=band_width,
@@ -201,19 +197,33 @@ def _fused_pass(
             best = {k: jnp.where(better, cur[k], best[k]) for k in best}
 
     # --- UMI fuzzy location in both adapter windows (extract_umis.py:19-126)
-    w5 = jnp.take(jnp.asarray(encode.CODE_TO_MASK), codes[:, :a5].astype(jnp.int32))
-    l5 = jnp.minimum(lens, a5)
-    d5, s5, e5 = fuzzy_match.fuzzy_find(umi_fwd_mask, w5, l5)
-    start3 = jnp.maximum(lens - a3, 0)
-    idx3 = jnp.clip(start3[:, None] + jnp.arange(a3, dtype=jnp.int32)[None, :], 0, W - 1)
+    # fwd pattern on the 5' window + rev pattern on the 3' window of the
+    # virtual-trimmed read, gathered at the span offsets and stacked into
+    # ONE multi-pattern dispatch (windows padded to a common width)
+    aw = max(a5, a3)
+    pos_w = jnp.arange(aw, dtype=jnp.int32)[None, :]
+    idx5 = jnp.clip(t_start[:, None] + pos_w, 0, W - 1)
+    w5 = jnp.take(jnp.asarray(encode.CODE_TO_MASK),
+                  jnp.take_along_axis(codes, idx5, axis=1).astype(jnp.int32))
+    w5 = jnp.where(pos_w < a5, w5, jnp.uint8(0))
+    l5 = jnp.minimum(lens_t, a5)
+    start3 = jnp.maximum(lens_t - a3, 0)  # trimmed-frame coords (downstream)
+    idx3 = jnp.clip((t_start + start3)[:, None] + pos_w, 0, W - 1)
     w3 = jnp.take(jnp.asarray(encode.CODE_TO_MASK),
                   jnp.take_along_axis(codes, idx3, axis=1).astype(jnp.int32))
-    l3 = jnp.minimum(lens, a3)
-    d3, s3, e3 = fuzzy_match.fuzzy_find(umi_rev_mask, w3, l3)
+    w3 = jnp.where(pos_w < a3, w3, jnp.uint8(0))
+    l3 = jnp.minimum(lens_t, a3)
+    ud, us, ue = fuzzy_match.fuzzy_find_multi(
+        umi_masks, umi_mask_lens,
+        jnp.concatenate([w5, w3], axis=0),
+        jnp.concatenate([l5, l3], axis=0),
+    )  # each (2, 2B)
+    d5, s5, e5 = ud[0, :B], us[0, :B], ue[0, :B]
+    d3, s3, e3 = ud[1, B:], us[1, B:], ue[1, B:]
 
     blast_id = best["n_match"] / jnp.maximum(best["n_cols"], 1)
-    out = {
-        "codes": codes, "lens": lens, "t_start": t_start,
+    return {
+        "lens": lens_t, "t_start": t_start,
         "ee_ok": ee_ok, "is_rev": is_rev,
         "ridx": best["ridx"], "score": best["score"],
         "blast_id": blast_id.astype(jnp.float32),
@@ -222,9 +232,6 @@ def _fused_pass(
         "d5": d5, "s5": s5, "e5": e5,
         "d3": d3, "s3": s3, "e3": e3, "start3": start3,
     }
-    if has_quals:
-        out["quals"] = quals
-    return out
 
 
 # ---------------------------------------------------------------------------
@@ -359,17 +366,28 @@ class AssignEngine:
         self.a3 = a3
         self.trim_window = trim_window
         self.mesh = mesh
-        self.umi_fwd_mask = jnp.asarray(encode.encode_mask(umi_fwd))
-        self.umi_rev_mask = jnp.asarray(encode.encode_mask(umi_rev))
+
+        def stack_masks(masks: list[np.ndarray]) -> tuple[jax.Array, jax.Array]:
+            stacked, lens_ = encode.pad_batch(masks, pad_value=0, multiple=1)
+            return jnp.asarray(stacked), jnp.asarray(lens_)
+
+        self.umi_masks, self.umi_mask_lens = stack_masks(
+            [encode.encode_mask(umi_fwd), encode.encode_mask(umi_rev)]
+        )
         primers = primers or []
-        self.primer_masks = tuple(
-            jnp.asarray(encode.encode_mask(p)) for p in primers
-        )
-        self.primer_rc_masks = tuple(
-            jnp.asarray(encode.encode_mask(encode.revcomp_str(p))) for p in primers
-        )
-        self.primer_max_dists = tuple(
-            jnp.int32(max(1, int(len(p) * primer_max_dist_frac))) for p in primers
+        if primers:
+            self.primer_stack, self.primer_stack_lens = stack_masks(
+                [encode.encode_mask(p) for p in primers]
+                + [encode.encode_mask(encode.revcomp_str(p)) for p in primers]
+            )
+        else:
+            self.primer_stack = jnp.zeros((0, 1), jnp.uint8)
+            self.primer_stack_lens = jnp.zeros((0,), jnp.int32)
+        self.primer_max_dists = jnp.asarray(
+            np.array(
+                [max(1, int(len(p) * primer_max_dist_frac)) for p in primers],
+                np.int32,
+            )
         )
         self.primer_shapes = tuple(len(p) for p in primers)
         self._sharded_cache: dict[bool, object] = {}
@@ -399,13 +417,10 @@ class AssignEngine:
 
         d1, d2 = P("data"), P("data", None)
         rep = P()
-        n_p = len(self.primer_masks)
         in_specs = (
             d2, d2 if has_quals else rep, d1,
             rep, rep, rep, rep, rep,
-            tuple(rep for _ in range(n_p)),
-            tuple(rep for _ in range(n_p)),
-            tuple(rep for _ in range(n_p)),
+            rep, rep, rep,
             rep, rep,
         )
         out_specs = {
@@ -414,9 +429,6 @@ class AssignEngine:
                       "blast_id", "ref_start", "ref_end", "read_start",
                       "read_end", "d5", "s5", "e5", "d3", "s3", "e3", "start3")
         }
-        out_specs["codes"] = d2
-        if has_quals:
-            out_specs["quals"] = d2
         fn = jax.jit(shard_map(
             base, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=False,
@@ -432,15 +444,18 @@ class AssignEngine:
             jnp.asarray(batch.quals) if has_quals else jnp.zeros((1, 1), jnp.uint8),
             jnp.asarray(batch.lengths),
             self.panel.d_codes, self.panel.d_lens, self.panel.d_profiles,
-            self.umi_fwd_mask, self.umi_rev_mask,
-            self.primer_masks, self.primer_rc_masks, self.primer_max_dists,
+            self.umi_masks, self.umi_mask_lens,
+            self.primer_stack, self.primer_stack_lens, self.primer_max_dists,
             jnp.float32(max_ee_rate), jnp.int32(min_len),
         )
         if self.mesh is not None:
             out = self._sharded_fn(has_quals)(*args)
         else:
             out = _fused_pass(*args, **self._static_kwargs(has_quals))
-        return {k: np.asarray(v) for k, v in out.items()}
+        # ONE batched device->host transfer: per-array readback pays a flat
+        # per-transfer latency (dramatic over a tunneled TPU: ~20 arrays of
+        # round-trips per batch), device_get coalesces them
+        return jax.device_get(out)
 
 
 _PREFETCH_DONE = object()
@@ -563,9 +578,12 @@ def run_assign(
         stats.n_ee_fail += int(nv - (ee_ok & valid).sum())
         stats.n_trimmed += int(((out["t_start"] > 0) & valid).sum())
         mean_quals = None
-        if "quals" in out:
-            in_read = np.arange(out["quals"].shape[1])[None, :] < lens[:, None]
-            qsum = np.where(in_read, out["quals"], 0).sum(axis=1)
+        if batch.quals is not None:
+            pos = np.arange(batch.quals.shape[1])[None, :]
+            in_span = (pos >= out["t_start"][:, None]) & (
+                pos < (out["t_start"] + lens)[:, None]
+            )
+            qsum = np.where(in_span, batch.quals, 0).sum(axis=1)
             mean_quals = qsum / np.maximum(lens, 1)
         stats.pre_filter.update(
             lens[valid], mean_quals[valid] if mean_quals is not None else None
@@ -619,8 +637,17 @@ def run_assign(
         rows = np.where(ok)[0]
         if len(rows) == 0:
             continue
+        # trimmed survivor codes, rebuilt host-side from the unshifted batch
+        # (the device pass trims virtually; see _fused_pass)
+        Wb = batch.codes.shape[1]
+        shift_idx = np.clip(
+            out["t_start"][rows][:, None] + np.arange(Wb)[None, :], 0, Wb - 1
+        )
+        shifted = np.take_along_axis(batch.codes[rows], shift_idx, axis=1)
+        in_new = np.arange(Wb)[None, :] < lens[rows][:, None]
+        trimmed_codes = np.where(in_new, shifted, encode.PAD_CODE).astype(np.uint8)
         acc[batch.width].append({
-            "codes": out["codes"][rows],
+            "codes": trimmed_codes,
             "lens": lens[rows],
             "is_rev": out["is_rev"][rows],
             "region_idx": out["ridx"][rows].astype(np.int32),

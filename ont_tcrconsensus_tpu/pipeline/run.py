@@ -47,6 +47,22 @@ def _log(*parts):
     print(*parts, file=sys.stderr)
 
 
+def enable_compilation_cache(cache_dir: str | None = None) -> None:
+    """Persist XLA executables across processes (first compile of the kernel
+    set costs minutes; every later pipeline invocation then starts warm).
+    Safe no-op when the backend rejects the cache."""
+    import jax
+
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            cache_dir or os.path.expanduser("~/.cache/ont_tcrconsensus_tpu_xla"),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as exc:  # unsupported backend/config: run cold
+        _log(f"compilation cache unavailable: {exc!r}")
+
+
 def run_pipeline(config_path: str, polisher=None) -> dict[str, dict[str, int]]:
     """Run the full pipeline; returns {library: {region: count}}."""
     cfg = RunConfig.from_json(config_path)
@@ -97,6 +113,7 @@ def resolve_batching(cfg: RunConfig, num_refs: int, mesh=None):
 def run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]:
     from ont_tcrconsensus_tpu.parallel import distributed as dist
 
+    enable_compilation_cache()
     if cfg.distributed:
         # no-op when already up (e.g. the CLI initialized pre-import);
         # required: a failed bring-up must abort, not degrade to N racing

@@ -177,8 +177,8 @@ def banded_cs_batch(queries: list[np.ndarray], refs: list[np.ndarray],
         return []
     qs = [np.asarray(q, dtype=np.int16) for q in queries]
     rs = [np.asarray(r, dtype=np.int16) for r in refs]
-    ns = np.array([len(q) for q in qs], np.int64)
-    ms = np.array([len(r) for r in rs], np.int64)
+    ns = np.array([len(q) for q in qs], np.int32)
+    ms = np.array([len(r) for r in rs], np.int32)
     # degenerate rows handled scalar (identical to banded_cs early-outs)
     out: list[str | None] = [None] * B
     halves_all = np.maximum(band // 2, np.abs(ns - ms) + 8)
@@ -215,19 +215,19 @@ def banded_cs_batch(queries: list[np.ndarray], refs: list[np.ndarray],
     # per-read, per-row band starts: row_lo(i) = clip(round(i*m/n) - half, 0, m)
     # (multiply-then-divide like banded_cs's round(i*m/n): exact int product
     # before the fp divide, so half-way cases round identically)
-    rows = np.arange(n_max + 1, dtype=np.int64)[None, :]
-    centers = np.rint(rows * m_arr[:, None] / n_arr[:, None]).astype(np.int64)
+    rows = np.arange(n_max + 1, dtype=np.int32)[None, :]
+    centers = np.rint(rows * m_arr[:, None] / n_arr[:, None]).astype(np.int32)
     lo_all = np.clip(centers - halves[:, None], 0, None)
     lo_all = np.minimum(lo_all, m_arr[:, None])          # (L, n_max+1)
 
     ptr = np.zeros((L, n_max + 1, W), dtype=np.uint8)
-    lanes = np.arange(W, dtype=np.int64)[None, :]        # (1, W)
+    lanes = np.arange(W, dtype=np.int32)[None, :]        # (1, W)
     lane_ok = lanes < Ws[:, None]                        # per-read band width
 
     # row 0: D[0][j] = j deletions for j in [lo, lo+W) ∩ [0, m]
     js0 = lo_all[:, 0:1] + lanes
     valid0 = lane_ok & (js0 <= m_arr[:, None])
-    prev = np.where(valid0, js0, BIG).astype(np.int64)
+    prev = np.where(valid0, js0, BIG).astype(np.int32)
     ptr[:, 0, :] = np.where(valid0, 2, 0)
 
     for i in range(1, n_max + 1):
@@ -259,7 +259,7 @@ def banded_cs_batch(queries: list[np.ndarray], refs: list[np.ndarray],
         take_left = (left < best) & valid
         best = np.where(take_left, left, best)
         p = np.where(take_left, 2, p).astype(np.uint8)
-        cur = np.where(valid, best, BIG).astype(np.int64)
+        cur = np.where(valid, best, BIG).astype(np.int32)
         ptr[:, i, :] = np.where(valid, p, 0)
         prev = np.where(alive[:, None], cur, prev)
 
@@ -271,13 +271,15 @@ def banded_cs_batch(queries: list[np.ndarray], refs: list[np.ndarray],
 
 
 def profile_store(store, panel, sample_size: int = 1000, seed: int = 0,
-                  chunk: int = 512):
+                  chunk: int = 1024):
     """cs-tag counters over a read-store sample.
 
     Returns (tag_counter, tag->region counter, tag->blast_id counter) — the
     same triple the reference builds from the BAM (minimap2_align.py:21-37).
     Reads are profiled in their aligned orientation against the reference
-    span recorded by the fused pass.
+    span recorded by the fused pass. The sample is processed in
+    length-sorted chunks: the vectorized DP row loop runs to each chunk's
+    longest read, so homogeneous chunks waste no rows.
     """
     from ont_tcrconsensus_tpu.ops import encode
 
@@ -288,6 +290,7 @@ def profile_store(store, panel, sample_size: int = 1000, seed: int = 0,
     if len(handles) > sample_size:
         pick = rng.choice(len(handles), size=sample_size, replace=False)
         handles = [handles[int(i)] for i in np.sort(pick)]
+    handles.sort(key=lambda h: int(store.blocks[h[0]].lens[h[1]]))
 
     tag_counter: Counter = Counter()
     tag_region: dict[str, Counter] = defaultdict(Counter)

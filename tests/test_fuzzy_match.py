@@ -100,3 +100,31 @@ def test_realistic_adapter_windows(pattern):
         assert d[i] == 0
         assert s[i] == pre_len
         assert win[s[i] : e[i]] == umi
+
+
+def test_multi_pattern_matches_per_pattern_calls():
+    """fuzzy_find_multi == one fuzzy_find per pattern, with padded
+    variable-length patterns handled exactly."""
+    rng = np.random.default_rng(5)
+    patterns = [UMI_FWD, UMI_REV, "ACGTACGTACGTACGTACGT", "TTTTGGGGCCCCAAA"]
+    texts = []
+    for _ in range(16):
+        n = int(rng.integers(20, 120))
+        texts.append("".join(rng.choice(list("ACGT")) for _ in range(n)))
+    wm, lens = encode.encode_mask_batch(texts)
+
+    masks = [encode.encode_mask(p) for p in patterns]
+    m = max(len(x) for x in masks)
+    stack = np.zeros((len(masks), m), np.uint8)
+    for i, x in enumerate(masks):
+        stack[i, : len(x)] = x
+    plens = np.array([len(x) for x in masks], np.int32)
+
+    dm, sm, em = (np.asarray(a) for a in fuzzy_match.fuzzy_find_multi(
+        stack, plens, wm, lens
+    ))
+    for i, p in enumerate(patterns):
+        d, s, e = _run_batch(p, texts)
+        np.testing.assert_array_equal(dm[i], d, err_msg=p)
+        np.testing.assert_array_equal(sm[i], s, err_msg=p)
+        np.testing.assert_array_equal(em[i], e, err_msg=p)
