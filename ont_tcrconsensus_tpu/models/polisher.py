@@ -106,19 +106,11 @@ def polish_draft(
     return out, int(kept.size)
 
 
-def _device_polish_batch(params, sub, lens, drafts, dlens, band_width):
-    """(C,S,W) cluster tile -> (pred (C,W), confidence (C,W), depth (C,W)).
-
-    One pileup + one RNN dispatch for the whole tile — the batched medaka
-    pass (medaka_polish.py:95-144 analogue, without the per-cluster
-    subprocess fan-out the reference schedules around).
-    """
+def _polish_from_pileup(params, base_at, ins_cnt, drafts):
+    """(C,S,W) pileup columns -> (pred, confidence, depth), each (C,W)."""
     from ont_tcrconsensus_tpu.ops import consensus as consensus_mod
     from ont_tcrconsensus_tpu.ops import pileup as pileup_mod
 
-    base_at, ins_cnt, _, _ = pileup_mod.pileup_columns_batch(
-        sub, lens, drafts, dlens, band_width=band_width, out_len=drafts.shape[1]
-    )
     feats = jax.vmap(consensus_mod.pileup_features)(base_at, ins_cnt, drafts)
     logits = apply_logits(params, feats)  # (C, W, 5)
     probs = jax.nn.softmax(logits, axis=-1)
@@ -128,26 +120,59 @@ def _device_polish_batch(params, sub, lens, drafts, dlens, band_width):
     return pred, conf, depth
 
 
+def _device_polish_batch(params, sub, lens, drafts, dlens, band_width):
+    """(C,S,W) cluster tile -> (pred (C,W), confidence (C,W), depth (C,W)).
+
+    One pileup + one RNN dispatch for the whole tile — the batched medaka
+    pass (medaka_polish.py:95-144 analogue, without the per-cluster
+    subprocess fan-out the reference schedules around).
+    """
+    from ont_tcrconsensus_tpu.ops import pileup as pileup_mod
+
+    base_at, ins_cnt, _, _ = pileup_mod.pileup_columns_batch(
+        sub, lens, drafts, dlens, band_width=band_width, out_len=drafts.shape[1]
+    )
+    return _polish_from_pileup(params, base_at, ins_cnt, drafts)
+
+
 _device_polish_batch_jit = jax.jit(
     _device_polish_batch, static_argnames=("band_width",)
 )
+_polish_from_pileup_jit = jax.jit(_polish_from_pileup)
 
 
-def make_pipeline_polisher(params, band_width: int = 128,
+def make_pipeline_polisher(params, band_width: int | None = None,
                            min_confidence: float = 0.9):
     """Adapter for ``stages.polish_clusters_all(polisher=...)``.
 
-    Returns f(sub (C,S,W), lens (C,S), drafts (C,W), dlens (C,)) ->
-    (polished (C,W), polished_lens (C,)): one device dispatch per cluster
-    tile; the tiny splice of predicted deletions happens host-side.
+    Returns f(sub (C,S,W), lens (C,S), drafts (C,W), dlens (C,),
+    pileup=None) -> (polished (C,W), polished_lens (C,)): one device
+    dispatch per cluster tile; the tiny splice of predicted deletions
+    happens host-side. When the consensus stage hands over its final-round
+    device pileup (the converged round's columns ARE the final draft's
+    pileup), the polisher skips recomputing it — the single most expensive
+    kernel in the polish path.
     """
+    from ont_tcrconsensus_tpu.ops.consensus import POLISH_BAND_WIDTH
     from ont_tcrconsensus_tpu.ops.encode import PAD_CODE
 
-    def polish(sub, lens, drafts, dlens):
-        pred, conf, depth = jax.device_get(_device_polish_batch_jit(
-            params, jnp.asarray(sub), jnp.asarray(lens),
-            jnp.asarray(drafts), jnp.asarray(dlens), band_width,
-        ))
+    default_band = POLISH_BAND_WIDTH if band_width is None else band_width
+
+    def polish(sub, lens, drafts, dlens, pileup=None, band_width=None):
+        """``band_width`` is forwarded by the polish stage so recomputed
+        pileups use the SAME band the consensus rounds (and any reused
+        pileup) did — two knobs drifting apart would mix feature scales
+        within one run."""
+        if pileup is not None:
+            base_at, ins_cnt = pileup
+            out = _polish_from_pileup_jit(params, base_at, ins_cnt, jnp.asarray(drafts))
+        else:
+            out = _device_polish_batch_jit(
+                params, jnp.asarray(sub), jnp.asarray(lens),
+                jnp.asarray(drafts), jnp.asarray(dlens),
+                default_band if band_width is None else band_width,
+            )
+        pred, conf, depth = jax.device_get(out)
         drafts = np.asarray(drafts)
         dlens = np.asarray(dlens)
         C, W = drafts.shape

@@ -30,6 +30,13 @@ import numpy as np
 from ont_tcrconsensus_tpu.ops import pileup
 from ont_tcrconsensus_tpu.ops.encode import PAD_CODE
 
+# The ONE band width of the polish path — consensus rounds, polisher serving
+# AND polisher training/eval all build pileups with it (skew between them
+# would feed the model features it never saw). Same-molecule subreads drift
+# only by their own indels (sigma ~6 nt over 2 kb at ONT rates), so +/-32 is
+# >4 sigma while halving the pileup kernel's per-row work vs 128.
+POLISH_BAND_WIDTH = 64
+
 
 @functools.partial(jax.jit, static_argnames=())
 def vote_columns(
@@ -124,7 +131,7 @@ def consensus_cluster(
     subreads: np.ndarray,
     subread_lens: np.ndarray,
     rounds: int = 4,
-    band_width: int = 128,
+    band_width: int = POLISH_BAND_WIDTH,
     pad_to: int | None = None,
 ) -> tuple[np.ndarray, int]:
     """Host driver: consensus of one UMI cluster's subreads.
@@ -234,17 +241,24 @@ def consensus_clusters_batch(
     subreads: np.ndarray,
     subread_lens: np.ndarray,
     rounds: int = 4,
-    band_width: int = 128,
-) -> tuple[np.ndarray, np.ndarray]:
+    band_width: int = POLISH_BAND_WIDTH,
+    keep_final_pileup: bool = False,
+) -> tuple[np.ndarray, np.ndarray] | tuple[np.ndarray, np.ndarray, tuple | None]:
     """Batched :func:`consensus_cluster` over C same-shape clusters.
 
     Args:
       subreads: (C, S, W) uint8 dense codes (0-length rows = padding);
       subread_lens: (C, S).
+      keep_final_pileup: also return the last round's device pileup
+        ``(base_at, ins_cnt)`` when it was computed against the FINAL drafts
+        (i.e. the loop exited via convergence, so the pre-vote drafts equal
+        the returned ones) — the RNN polisher consumes exactly that pileup
+        and can skip recomputing it. ``None`` when the loop hit the rounds
+        cap still changing.
 
-    Returns (drafts (C, W), draft_lens (C,)). One device dispatch per round
-    covers every cluster — the per-cluster host loop only handles seed
-    selection, end extension, and convergence checks.
+    Returns (drafts (C, W), draft_lens (C,)[, final_pileup]). One device
+    dispatch per round covers every cluster — the per-cluster host loop only
+    handles seed selection, end extension, and convergence checks.
     """
     C, S, W = subreads.shape
     subread_lens = np.asarray(subread_lens)
@@ -260,6 +274,8 @@ def consensus_clusters_batch(
         drafts[c, :n] = subreads[c, seed, :n]
         dlens[c] = n
 
+    converged = False
+    base_at = ins_cnt = None
     for _ in range(rounds):
         base_at, ins_cnt, ins_base, spans = pileup.pileup_columns_batch(
             subreads, subread_lens, jnp.asarray(drafts), jnp.asarray(dlens),
@@ -289,8 +305,12 @@ def consensus_clusters_batch(
         )
         drafts, dlens = new_drafts, new_lens
         if all_unchanged:
+            converged = True
             break
-    return drafts, dlens
+    if not keep_final_pileup:
+        return drafts, dlens
+    final_pileup = (base_at, ins_cnt) if converged else None
+    return drafts, dlens, final_pileup
 
 
 @functools.partial(jax.jit, static_argnames=())

@@ -92,7 +92,14 @@ class RunConfig:
 
     # --- polishing ---
     # "poa" = draft consensus only; "rnn" = draft + Flax polisher pass.
-    polish_method: str = "rnn"
+    # Default is "poa": the precision-at-depth eval (models/weights/
+    # polisher_v1_eval.json, regenerate via `python -m ...models.train`)
+    # measures ZERO exactness gain from the RNN over the vote consensus at
+    # every depth 2-10 on pipeline-realistic 1.6 kb templates — the vote
+    # already converges to the truth wherever depth permits. "rnn" remains
+    # available for error regimes where a retrained model does earn its
+    # pileup+RNN pass.
+    polish_method: str = "poa"
 
     # --- TPU execution (new; no reference analogue) ---
     hbm_budget_gb: float | None = None  # None -> detect chip HBM (the one
@@ -107,8 +114,9 @@ class RunConfig:
     #   shard-by-barcode across processes (parallel/distributed.py)
     resume: bool = False              # stage-level resume from manifest
     write_intermediate_fastas: bool = True  # per-stage fasta artifacts
-    error_profile_sample: int = 1000  # reads/library profiled for the cs-tag
-    #   error artifact (qc/error_profile.py); 0 disables
+    error_profile_sample: int = 512  # reads/library profiled for the cs-tag
+    #   error artifact (qc/error_profile.py); 0 disables. 512 resolves any
+    #   motif above ~1% of reads in the top-40 dump; raise for deeper audits
 
     @property
     def cluster_identity(self) -> float:

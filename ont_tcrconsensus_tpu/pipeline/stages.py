@@ -254,7 +254,7 @@ def polish_clusters_all(
     store: ReadStore,
     max_read_length: int = 4096,
     rounds: int = 4,
-    band_width: int = 128,
+    band_width: int = consensus_mod.POLISH_BAND_WIDTH,
     polisher=None,
     cluster_batch: int | None = None,
     budget=None,
@@ -350,11 +350,15 @@ def polish_clusters_all(
                         [sub, np.full((pad, s_bucket, width), encode.PAD_CODE, np.uint8)]
                     )
                     lens = np.concatenate([lens, np.zeros((pad, s_bucket), lens.dtype)])
-                drafts, dlens = consensus_mod.consensus_clusters_batch(
-                    sub, lens, rounds=rounds, band_width=band_width
+                drafts, dlens, *rest = consensus_mod.consensus_clusters_batch(
+                    sub, lens, rounds=rounds, band_width=band_width,
+                    keep_final_pileup=polisher is not None,
                 )
                 if polisher is not None:
-                    drafts, dlens = polisher(sub, lens, drafts, dlens)
+                    drafts, dlens = polisher(
+                        sub, lens, drafts, dlens, pileup=rest[0],
+                        band_width=band_width,
+                    )
                 seqs = encode.decode_batch(drafts[:C], dlens[:C])
             except Exception as exc:
                 for group_name, _, _, _ in chunk:

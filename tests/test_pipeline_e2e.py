@@ -201,7 +201,7 @@ def test_pipeline_degrades_gracefully_on_poisoned_group(sim_library, tmp_path, m
         # poison the device chunks that contain the target group: the
         # library-wide batcher must fail ONLY the chunk's groups and
         # complete every other chunk (its per-chunk try/except)
-        def poison_polisher(sub, lens, drafts, dlens):
+        def poison_polisher(sub, lens, drafts, dlens, **_kw):
             raise RuntimeError("injected failure")
 
         ok_groups = [(g, s) for g, s in selected_by_group if g != poisoned]

@@ -93,3 +93,36 @@ def test_pipeline_adapter_preserves_good_consensus():
     out0, l0 = fn(sub0, np.zeros((1, S), np.int32),
                   np.full((1, W), encode.PAD_CODE, np.uint8), np.zeros((1,), np.int32))
     assert l0[0] == 0
+
+
+def test_pileup_reuse_path_matches_recompute():
+    """polish(pileup=<final converged pileup>) must produce output identical
+    to the from-scratch recompute — the fast path the pipeline takes when
+    consensus_clusters_batch exits via convergence."""
+    from ont_tcrconsensus_tpu.io import simulator
+    from ont_tcrconsensus_tpu.ops import consensus
+
+    params = polisher.init_params(0)
+    rng = np.random.default_rng(7)
+    C, S, W = 2, 6, 256
+    sub = np.full((C, S, W), encode.PAD_CODE, np.uint8)
+    lens = np.zeros((C, S), np.int32)
+    for c in range(C):
+        template = simulator._rand_seq(rng, 180)
+        for i in range(S):
+            s, _ = simulator.mutate(rng, template, 0.01, 0.005, 0.005)
+            enc = encode.encode_seq(s)
+            sub[c, i, : len(enc)] = enc
+            lens[c, i] = len(enc)
+
+    drafts, dlens, final_pileup = consensus.consensus_clusters_batch(
+        sub, lens, rounds=6, band_width=consensus.POLISH_BAND_WIDTH,
+        keep_final_pileup=True,
+    )
+    assert final_pileup is not None, "deep-depth clusters must converge"
+
+    fn = polisher.make_pipeline_polisher(params)
+    out_fast, lens_fast = fn(sub, lens, drafts, dlens, pileup=final_pileup)
+    out_slow, lens_slow = fn(sub, lens, drafts, dlens)
+    np.testing.assert_array_equal(lens_fast, lens_slow)
+    np.testing.assert_array_equal(out_fast, out_slow)
