@@ -129,7 +129,7 @@ def _device_polish_batch(params, sub, lens, drafts, dlens, band_width):
     """
     from ont_tcrconsensus_tpu.ops import pileup as pileup_mod
 
-    base_at, ins_cnt, _, _ = pileup_mod.pileup_columns_batch(
+    base_at, ins_cnt, _, _ = pileup_mod.pileup_columns_batch_auto(
         sub, lens, drafts, dlens, band_width=band_width, out_len=drafts.shape[1]
     )
     return _polish_from_pileup(params, base_at, ins_cnt, drafts)

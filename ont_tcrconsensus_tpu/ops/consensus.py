@@ -277,7 +277,7 @@ def consensus_clusters_batch(
     converged = False
     base_at = ins_cnt = None
     for _ in range(rounds):
-        base_at, ins_cnt, ins_base, spans = pileup.pileup_columns_batch(
+        base_at, ins_cnt, ins_base, spans = pileup.pileup_columns_batch_auto(
             subreads, subread_lens, jnp.asarray(drafts), jnp.asarray(dlens),
             band_width=band_width, out_len=W,
         )

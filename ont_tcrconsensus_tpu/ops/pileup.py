@@ -253,6 +253,217 @@ def pileup_columns(
     )
 
 
+@functools.partial(jax.jit, static_argnames=("band_width",))
+def _forward_batch(reads, read_lens, refs, ref_lens, band_width: int):
+    """vmapped :func:`_forward_banded` over flat lanes (offsets 0);
+    returns (best (N, 3), planes (N, L, W) uint16).
+
+    The two direction planes are packed into one uint16
+    (``tdir | fjump << 4``) so the traceback's serial chain pays ONE random
+    gather per step instead of two — on TPU a batched random gather
+    serializes into per-lane scalar loads, making it the traceback's unit
+    of cost.
+    """
+    scoring = (MATCH, MISMATCH, GAP_OPEN, GAP_EXT)
+
+    def one(read, rlen, ref, tlen):
+        best, tdir, fjump = _forward_banded(
+            read, rlen, ref, tlen, jnp.int32(0), band_width, scoring
+        )
+        return best, tdir.astype(jnp.uint16) | (fjump.astype(jnp.uint16) << 4)
+
+    return jax.vmap(one)(
+        reads, read_lens.astype(jnp.int32), refs, ref_lens.astype(jnp.int32)
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("band_width", "out_len"))
+def _traceback_batch(best, planes, reads, band_width: int, out_len: int):
+    """Scan-log traceback over flat lanes (offsets 0).
+
+    The while_loop traceback (:func:`_traceback_one`) scatters into the
+    (N, out_len) column arrays at EVERY step and gathers three arrays per
+    step — and a data-dependent random gather is the serial unit of cost on
+    TPU (it lowers to per-lane scalar loads). This version pays exactly ONE
+    gather inside the chain (the packed u16 direction plane from
+    :func:`_forward_batch`), keeps 7 scalars of per-lane state, and logs
+    each step's move as one packed int32. Everything else happens
+    vectorized afterwards:
+
+    - read bases are gathered for the whole log at once (the log stores
+      read INDICES — base identity never affects the walk itself);
+    - ``base_at``: one set per logged (lane, j) — indices are unique (a
+      draft column is consumed at most once per walk);
+    - ``ins_cnt``: scatter-add of the logged insertion steps;
+    - ``ins_base``: the FIRST base of each insertion run = the run's
+      latest traceback step, recovered deterministically as a scatter-max
+      of ``t * 4 + base`` (t strictly increases over the scan).
+
+    Step count is the static worst case (read length + draft length); dead
+    lanes emit drop-sentinel indices. Bit-identical to the while_loop
+    version (asserted by tests).
+    """
+    N, L = reads.shape
+    W = band_width
+    c = W // 2
+    T = L + out_len
+    score, i0, b0 = best[:, 0], best[:, 1], best[:, 2]
+    jend = i0 - c + b0
+    MODE_H, MODE_E, MODE_TMP = jnp.int32(0), jnp.int32(1), jnp.int32(2)
+    lane = jnp.arange(N, dtype=jnp.int32)
+    planes_flat = planes.reshape(N, L * W)
+
+    # log op codes (2 bits): 0 none, 1 = deletion at j, 2 = diag read[i]
+    # over j, 3 = insertion read[i] after j
+    OP_DEL, OP_DIAG, OP_INS = 1, 2, 3
+    j_bits = max(out_len.bit_length(), 1)
+    i_bits = max(L.bit_length(), 1)
+
+    def step(carry, _):
+        i, b, mode, pending, done, rstart, fstart = carry
+        live = ~done
+        jrow = i - c + b
+        jc = jnp.clip(jrow, 0, out_len - 1)
+        j_ok = (jrow >= 0) & (jrow < out_len) & live
+        ci = jnp.clip(i, 0, L - 1)
+        cb = jnp.clip(b, 0, W - 1)
+        p = jnp.take_along_axis(
+            planes_flat, (ci * W + cb)[:, None], axis=1
+        )[:, 0].astype(jnp.int32)
+        d = p & 15
+        m = p >> 4
+
+        in_del = pending > 0
+        start_del = ~in_del & (mode == MODE_H) & (m > 0)
+        do_del = in_del | start_del
+        new_pending = jnp.where(in_del, pending - 1, jnp.where(start_del, m - 1, 0))
+
+        choice = jnp.where(mode == MODE_E, jnp.int32(_EGAP), d & 3)
+        is_diag = ~do_del & (choice == _DIAG)
+        is_egap = ~do_del & (choice == _EGAP)
+        is_fresh = ~do_del & (choice == _FRESH)
+
+        op = jnp.where(
+            do_del & j_ok, OP_DEL,
+            jnp.where(
+                is_diag & j_ok, OP_DIAG, jnp.where(is_egap & j_ok, OP_INS, 0)
+            ),
+        )
+        log = (op << (j_bits + i_bits)) | (ci << j_bits) | jc
+
+        e_open = (d & _EOPEN_BIT) != 0
+        diag_stop = is_diag & ((d & _DIAG_STOP_BIT) != 0)
+
+        ni = jnp.where(is_diag | is_egap, i - 1, i)
+        nb = jnp.where(do_del, b - 1, jnp.where(is_egap, b + 1, b))
+        nmode = jnp.where(
+            do_del, MODE_TMP, jnp.where(is_egap & ~e_open, MODE_E, MODE_H)
+        )
+        ndone = done | is_fresh | diag_stop | (ni < 0) | (nb < 0) | (nb >= W)
+        nrstart = jnp.where(live & (is_diag | is_egap), i, rstart)
+        nfstart = jnp.where(live & (is_diag | do_del), jrow, fstart)
+        new_carry = (
+            jnp.where(live, ni, i), jnp.where(live, nb, b),
+            jnp.where(live, nmode, mode), jnp.where(live, new_pending, pending),
+            ndone, nrstart, nfstart,
+        )
+        return new_carry, log
+
+    init = (
+        i0, b0, jnp.full((N,), MODE_H), jnp.zeros((N,), jnp.int32),
+        (score <= 0) | (i0 < 0),
+        i0 + 1, jend + 1,
+    )
+    (_, _, _, _, _, rstart, fstart), logs = jax.lax.scan(
+        step, init, None, length=T
+    )
+
+    # vectorized log decode + column materialization
+    jc_t = logs & ((1 << j_bits) - 1)
+    i_t = (logs >> j_bits) & ((1 << i_bits) - 1)
+    op_t = logs >> (j_bits + i_bits)
+    rb_t = jnp.take_along_axis(reads, i_t.T.astype(jnp.int32), axis=1).T
+    rb_known = rb_t < 4
+
+    set_hit = (op_t == OP_DEL) | ((op_t == OP_DIAG) & rb_known)
+    set_j = jnp.where(set_hit, jc_t, out_len)
+    set_v = jnp.where(op_t == OP_DEL, jnp.uint8(DELETION), rb_t.astype(jnp.uint8))
+    ins_hit = (op_t == OP_INS) & rb_known
+    ins_j = jnp.where(ins_hit, jc_t, out_len)
+    ts = jnp.arange(T, dtype=jnp.int32)[:, None]
+    ins_pk = ts * 4 + (rb_t & 3).astype(jnp.int32)
+
+    lanes_T = jnp.broadcast_to(lane[None, :], (T, N))
+    base_at = jnp.full((N, out_len), UNCOVERED, jnp.uint8)
+    base_at = base_at.at[lanes_T, set_j].set(set_v, mode="drop")
+    ins_cnt = jnp.zeros((N, out_len), jnp.int32)
+    ins_cnt = ins_cnt.at[lanes_T, ins_j].add(1, mode="drop")
+    pk0 = jnp.full((N, out_len), -1, jnp.int32)
+    pk = pk0.at[lanes_T, ins_j].max(ins_pk, mode="drop")
+    ins_base = jnp.where(pk >= 0, (pk % 4).astype(jnp.uint8), jnp.uint8(0))
+    spans = jnp.stack([rstart, i0 + 1, fstart, jend + 1], axis=1)
+    return base_at, ins_cnt, ins_base, spans
+
+
+def pileup_columns_batch_auto(
+    subreads,
+    subread_lens,
+    drafts,
+    draft_lens,
+    band_width: int = 128,
+    out_len: int | None = None,
+    force_pallas: bool = False,
+):
+    """:func:`pileup_columns_batch` split into flat-lane forward + scan-log
+    traceback — the production pileup path.
+
+    The fused vmapped version pays thousands of sequential multi-MB
+    scatters in its while_loop traceback; here the forward emits direction
+    planes once and :func:`_traceback_batch` logs steps with scalar state,
+    scattering the columns in one shot (~3x on the real chip). On CPU the
+    fused XLA version runs (small test shapes, no win to split).
+    ``force_pallas`` routes the forward through the Pallas kernel
+    (:mod:`.pileup_pallas`; interpreter on CPU) — the equivalence-test hook
+    for that kernel, which currently trails the XLA forward on the tunneled
+    chip and is kept as groundwork, not the default.
+    """
+    if out_len is None:
+        out_len = drafts.shape[-1]
+    on_cpu = jax.default_backend() == "cpu"
+    if on_cpu and not force_pallas:
+        return pileup_columns_batch(
+            subreads, subread_lens, drafts, draft_lens,
+            band_width=band_width, out_len=out_len,
+        )
+    C, S, L = subreads.shape
+    lanes = C * S
+    reads = jnp.asarray(subreads).reshape(lanes, L)
+    rlens = jnp.asarray(subread_lens).reshape(lanes)
+    refs = jnp.repeat(jnp.asarray(drafts), S, axis=0)
+    reflens = jnp.repeat(jnp.asarray(draft_lens).astype(jnp.int32), S)
+    if force_pallas:
+        from ont_tcrconsensus_tpu.ops import pileup_pallas
+
+        best, tdir, fjump = pileup_pallas.forward_planes_pallas(
+            reads, rlens, refs, reflens, band_width=band_width,
+            interpret=on_cpu,
+        )
+        planes = tdir.astype(jnp.uint16) | (fjump.astype(jnp.uint16) << 4)
+    else:
+        best, planes = _forward_batch(
+            reads, rlens, refs, reflens, band_width=band_width
+        )
+    base_at, ins_cnt, ins_base, spans = _traceback_batch(
+        best, planes, reads, band_width, out_len
+    )
+    return (
+        base_at.reshape(C, S, out_len),
+        ins_cnt.reshape(C, S, out_len),
+        ins_base.reshape(C, S, out_len),
+        spans.reshape(C, S, 4),
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("band_width", "out_len"))
 def pileup_columns_batch(
     subreads: jax.Array,

@@ -1,0 +1,260 @@
+"""Pallas TPU kernel for the pileup forward pass (direction planes).
+
+Cell-exact equal to :func:`.pileup._forward_banded` (asserted by tests via
+the interpreter and the ``-m tpu`` lane), but the row recurrence runs with
+its DP carry resident in VMEM instead of round-tripping HBM every scan step
+— the same trade :mod:`.sw_pallas` makes for the stats-only kernel. The
+direction planes (``tdir``/``fjump``) are emitted row-by-row into one
+lane-packed (BLK, L, 2*W) uint8 output block, and the existing XLA
+``lax.while_loop`` traceback (:func:`.pileup._traceback_one`) consumes them
+unchanged.
+
+Layout tricks (see sw_pallas for the pattern):
+- drafts are pre-shifted host-side into ``ref_shifted[lane, k] =
+  draft[k - W/2]`` so each row's band window is one contiguous slice;
+- both planes share one output ref with the band (W=64) doubled along the
+  minor axis to a full 128-lane tile: ``[:, i, :W] = tdir``,
+  ``[:, i, W:] = fjump``;
+- the per-slot best (score, earliest row) is tracked in VMEM and the
+  sequential tie-break (max score -> earliest row -> smallest slot) is
+  reproduced outside the kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ont_tcrconsensus_tpu.ops.pileup import (
+    _DIAG,
+    _DIAG_STOP_BIT,
+    _EGAP,
+    _EOPEN_BIT,
+    _FRESH,
+)
+from ont_tcrconsensus_tpu.ops.sw_align import (
+    GAP_EXT,
+    GAP_OPEN,
+    MATCH,
+    MISMATCH,
+    PAD_SENTINEL,
+)
+
+_NEG = -(1 << 24)
+BLK = 16  # lanes (subread alignments) per program
+
+
+def _forward_kernel(read_ref, refsh_ref, rlen_ref, tlen_ref,
+                    planes_ref, bestH_ref, bestRow_ref,
+                    *, L, W, match, mismatch, gap_open, gap_ext):
+    c = W // 2
+    iota = jax.lax.broadcasted_iota(jnp.int32, (BLK, W), 1)
+    rlen = rlen_ref[:]
+    tlen = tlen_ref[:]
+    neg = jnp.full((BLK, W), _NEG, jnp.int32)
+
+    lane128 = jax.lax.broadcasted_iota(jnp.int32, (BLK, 128), 1)
+
+    def shift_up(x, fill):
+        return jnp.concatenate([x[:, 1:], jnp.full((BLK, 1), fill, x.dtype)], axis=1)
+
+    def shift_right(x, step, fill):
+        return jnp.concatenate([jnp.full((BLK, step), fill, x.dtype), x[:, :-step]], axis=1)
+
+    def elem_at(ref, k):
+        base = pl.multiple_of((k // 128) * 128, 128)
+        chunk = ref[:, pl.ds(base, 128)].astype(jnp.int32)
+        sel = lane128 == (k % 128)
+        return jnp.sum(jnp.where(sel, chunk, 0), axis=1, keepdims=True)
+
+    def row_step(i, carry):
+        H, E, bH, bRow, window = carry
+        jrow = i - c + iota                         # (BLK, W); offsets are 0
+        valid = (jrow >= 0) & (jrow < tlen) & (i < rlen)
+        rbase = elem_at(read_ref, i)
+        tbase = window
+        is_match = (tbase == rbase) & (rbase < 4) & (tbase < 4)
+        sub = jnp.where(is_match, match, -mismatch)
+        window = jnp.concatenate([window[:, 1:], elem_at(refsh_ref, i + W)], axis=1)
+
+        H_up = shift_up(H, _NEG)
+        E_up = shift_up(E, _NEG)
+        open_sc = H_up - gap_open - gap_ext
+        ext_sc = E_up - gap_ext
+        e_open = open_sc >= ext_sc
+        E_new = jnp.where(e_open, open_sc, ext_sc)
+
+        fresh_pred = 0 > H
+        D = jnp.where(fresh_pred, 0, H) + sub
+
+        # direction planes stay int32 inside the kernel (i1 masks from
+        # 32-bit compares cannot relayout onto 8-bit (32,128) tiles); one
+        # cast happens at the aligned group store
+        tmp = D
+        tdir = jnp.where(fresh_pred, _DIAG | _DIAG_STOP_BIT, _DIAG)
+        e_better = E_new > tmp
+        tmp = jnp.where(e_better, E_new, tmp)
+        tdir = jnp.where(e_better, _EGAP, tdir)
+        fresh_better = 0 > tmp
+        tmp = jnp.where(fresh_better, 0, tmp)
+        tdir = jnp.where(fresh_better, _FRESH, tdir)
+        tmp = jnp.where(valid, tmp, neg)
+        tdir = tdir | jnp.where(e_open, _EOPEN_BIT, 0)
+
+        # F cascade (shift-doubling) with ref-gap run length tracking
+        g = tmp
+        gap = jnp.zeros_like(tmp)
+        step = 1
+        while step < W:
+            cand_g = shift_right(g, step, _NEG) - gap_ext * step
+            cand_gap = shift_right(gap, step, 0) + step
+            take = cand_g > g
+            g = jnp.where(take, cand_g, g)
+            gap = jnp.where(take, cand_gap, gap)
+            step *= 2
+        F = shift_right(g, 1, _NEG) - gap_open - gap_ext
+        jump = shift_right(gap, 1, 0) + 1
+
+        take_f = F > tmp
+        H_new = jnp.where(valid, jnp.where(take_f, F, tmp), neg)
+        fjump = jnp.where(take_f, jump, 0)
+
+        imp = H_new > bH
+        bH = jnp.where(imp, H_new, bH)
+        bRow = jnp.where(
+            imp, jnp.broadcast_to(jnp.full((BLK, 1), i, jnp.int32), (BLK, W)), bRow
+        )
+        E_new = jnp.where(valid, E_new, neg)
+        return (H_new, E_new, bH, bRow, window), tdir, fjump
+
+    # Mosaic only allows VMEM stores at statically-aligned sublane offsets,
+    # so rows are buffered in registers and written in aligned groups of G.
+    G = 8
+
+    def group_body(gi, carry):
+        i0 = gi * G
+        rows = []
+        for k in range(G):
+            carry, tdir, fjump = row_step(i0 + k, carry)
+            rows.append(jnp.concatenate([tdir, fjump], axis=1))
+        block = jnp.stack(rows, axis=1)  # (BLK, G, 2W) int32
+        planes_ref[:, pl.ds(pl.multiple_of(i0, G), G), :] = block.astype(jnp.uint8)
+        return carry
+
+    window0 = refsh_ref[:, 0:W].astype(jnp.int32)
+    init = (
+        neg, neg,
+        jnp.zeros((BLK, W), jnp.int32), jnp.full((BLK, W), -1, jnp.int32),
+        window0,
+    )
+    out = jax.lax.fori_loop(0, L // G, group_body, init)
+    bestH_ref[:] = out[2]
+    bestRow_ref[:] = out[3]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("band_width", "interpret"),
+)
+def forward_planes_pallas(
+    reads: jax.Array,
+    read_lens: jax.Array,
+    refs: jax.Array,
+    ref_lens: jax.Array,
+    band_width: int = 64,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Banded forward DP for N lanes; returns (best (N, 3), tdir, fjump).
+
+    Args:
+      reads: (N, L) uint8; refs: (N, Lr) uint8 (the draft of each lane's
+        cluster); band centered on the main diagonal (offsets 0, the
+        same-molecule case the pileup path uses).
+
+    Returns:
+      best: (N, 3) int32 rows of (score, row, slot) matching
+        :func:`.pileup._forward_banded`'s sequential selection;
+      tdir/fjump: (N, L, W) uint8 planes.
+    """
+    N0, L = reads.shape
+    if L % 8:
+        raise ValueError(
+            f"read width {L} must be a multiple of 8 (the kernel writes "
+            "direction planes in aligned 8-row groups)"
+        )
+    W = band_width
+    c = W // 2
+    N = ((N0 + BLK - 1) // BLK) * BLK
+
+    def pad_to(x, n, fill):
+        if x.shape[0] == n:
+            return x
+        pad_shape = (n - x.shape[0],) + x.shape[1:]
+        return jnp.concatenate([x, jnp.full(pad_shape, fill, x.dtype)])
+
+    reads_p = pad_to(jnp.asarray(reads), N, PAD_SENTINEL)
+    refs_p = pad_to(jnp.asarray(refs), N, PAD_SENTINEL)
+    rlens = pad_to(jnp.asarray(read_lens, jnp.int32), N, 0)[:, None]
+    tlens = pad_to(jnp.asarray(ref_lens, jnp.int32), N, 0)[:, None]
+
+    # host-side pre-shift: ref_shifted[n, k] = ref[n, k - c]
+    K = L + W
+    ks = jnp.arange(K, dtype=jnp.int32)[None, :] - c
+    in_range = (ks >= 0) & (ks < refs_p.shape[1])
+    ref_shifted = jnp.where(
+        jnp.broadcast_to(in_range, (N, K)),
+        jnp.take_along_axis(
+            refs_p, jnp.broadcast_to(jnp.clip(ks, 0, refs_p.shape[1] - 1), (N, K)),
+            axis=1,
+        ),
+        jnp.uint8(PAD_SENTINEL),
+    )
+
+    kernel = functools.partial(
+        _forward_kernel, L=L, W=W, match=MATCH, mismatch=MISMATCH,
+        gap_open=GAP_OPEN, gap_ext=GAP_EXT,
+    )
+    grid = (N // BLK,)
+    row_spec = lambda cols: pl.BlockSpec(
+        (BLK, cols), lambda g: (g, 0), memory_space=pltpu.VMEM
+    )
+    planes_spec = pl.BlockSpec(
+        (BLK, L, 2 * W), lambda g: (g, 0, 0), memory_space=pltpu.VMEM
+    )
+    planes, bestH, bestRow = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[row_spec(L), row_spec(K), row_spec(1), row_spec(1)],
+        out_specs=[planes_spec, row_spec(W), row_spec(W)],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, L, 2 * W), jnp.uint8),
+            jax.ShapeDtypeStruct((N, W), jnp.int32),
+            jax.ShapeDtypeStruct((N, W), jnp.int32),
+        ],
+        interpret=interpret,
+    )(reads_p, ref_shifted, rlens, tlens)
+
+    # sequential tie-break: max score -> earliest row -> smallest slot
+    score = jnp.max(bestH, axis=1)
+    is_max = bestH == score[:, None]
+    row_or_inf = jnp.where(is_max, bestRow, jnp.int32(1 << 30))
+    best_row = jnp.min(row_or_inf, axis=1)
+    cand = is_max & (bestRow == best_row[:, None])
+    slot = jnp.argmax(cand, axis=1).astype(jnp.int32)
+    # _forward_banded reports best0 = (0, -1, 0) when nothing scored > 0
+    aligned = score > 0
+    best = jnp.stack(
+        [
+            jnp.where(aligned, score, 0),
+            jnp.where(aligned, best_row, -1),
+            jnp.where(aligned, slot, 0),
+        ],
+        axis=1,
+    )
+    tdir = planes[:, :, :W]
+    fjump = planes[:, :, W:]
+    return best[:N0], tdir[:N0], fjump[:N0]

@@ -86,7 +86,14 @@ class BudgetModel:
         votes = 2 * width * 4 * 8                      # vote stacks (int32)
         return traceback + pileup + votes
 
+    # Flat alignment lanes (clusters x subreads) per polish dispatch. Above
+    # this the pileup working set (direction planes + traceback log) crowds
+    # HBM without improving utilization — 4096 lanes already saturate the
+    # sequential DP scans.
+    MAX_POLISH_LANES = 4096
+
     def cluster_batch(self, s_bucket: int, width: int,
                       band_width: int = 128) -> int:
         per = self.cluster_bytes(s_bucket, width, band_width)
-        return _pow2_floor(self.budget_bytes // per, 1, 256)
+        hi = min(256, max(1, self.MAX_POLISH_LANES // max(s_bucket, 1)))
+        return _pow2_floor(self.budget_bytes // per, 1, hi)

@@ -113,3 +113,40 @@ assert stats.n_trimmed == len(records)
 print("FUSED_OK", store.num_reads)
 """)
     assert "FUSED_OK" in out
+
+
+@needs_tpu
+def test_pileup_paths_agree_on_tpu():
+    """The production pileup (XLA forward + scan-log traceback) and the
+    Pallas forward must both match the fused while_loop reference on the
+    real chip."""
+    out = _run_on_tpu(r"""
+import numpy as np
+from ont_tcrconsensus_tpu.io import simulator
+from ont_tcrconsensus_tpu.ops import encode, pileup
+rng = np.random.default_rng(11)
+C, S, W = 4, 6, 512
+sub = np.full((C, S, W), encode.PAD_CODE, np.uint8)
+lens = np.zeros((C, S), np.int32)
+drafts = np.full((C, W), encode.PAD_CODE, np.uint8)
+dlens = np.zeros((C,), np.int32)
+for c in range(C):
+    template = simulator._rand_seq(rng, 430)
+    for i in range(S):
+        s, _ = simulator.mutate(rng, template, 0.02, 0.008, 0.008)
+        e = encode.encode_seq(s)
+        sub[c, i, :len(e)] = e
+        lens[c, i] = len(e)
+    t = encode.encode_seq(template)
+    drafts[c, :len(t)] = t
+    dlens[c] = len(t)
+ref = pileup.pileup_columns_batch(sub, lens, drafts, dlens, band_width=64, out_len=W)
+for force_pallas in (False, True):
+    got = pileup.pileup_columns_batch_auto(
+        sub, lens, drafts, dlens, band_width=64, out_len=W,
+        force_pallas=force_pallas)
+    for a, b, n in zip(ref, got, ("base_at", "ins_cnt", "ins_base", "spans")):
+        assert (np.asarray(a) == np.asarray(b)).all(), (force_pallas, n)
+print("PILEUP_OK")
+""")
+    assert "PILEUP_OK" in out
