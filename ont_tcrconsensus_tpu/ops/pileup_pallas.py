@@ -201,8 +201,11 @@ def forward_planes_pallas(
     rlens = pad_to(jnp.asarray(read_lens, jnp.int32), N, 0)[:, None]
     tlens = pad_to(jnp.asarray(ref_lens, jnp.int32), N, 0)[:, None]
 
-    # host-side pre-shift: ref_shifted[n, k] = ref[n, k - c]
-    K = L + W
+    # host-side pre-shift: ref_shifted[n, k] = ref[n, k - c]. K is padded to
+    # a multiple of 128: elem_at loads aligned 128-column chunks, and a
+    # ragged tail would send the last rows' loads out of the block (silently
+    # clamped/garbage — wrong band windows for near-full-width drafts).
+    K = ((L + W + 127) // 128) * 128
     ks = jnp.arange(K, dtype=jnp.int32)[None, :] - c
     in_range = (ks >= 0) & (ks < refs_p.shape[1])
     ref_shifted = jnp.where(

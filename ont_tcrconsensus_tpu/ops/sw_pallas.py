@@ -223,8 +223,11 @@ def align_banded_pallas(
     tlens = pad_to(jnp.asarray(ref_lens, jnp.int32), B, 0)[:, None]
     offs = pad_to(jnp.asarray(diag_offsets, jnp.int32), B, 0)[:, None]
 
-    # host-side pre-shift: ref_shifted[b, k] = ref[b, k + off_b - c]
-    K = L + W
+    # host-side pre-shift: ref_shifted[b, k] = ref[b, k + off_b - c]. K is
+    # padded to a multiple of 128 (same fix as pileup_pallas): elem_at's
+    # aligned chunk loads must never start past K - 128, which a ragged
+    # tail would cause for non-multiple-of-128 L + W.
+    K = ((L + W + 127) // 128) * 128
     ks = jnp.arange(K, dtype=jnp.int32)[None, :] + offs - c  # (B, K)
     in_range = (ks >= 0) & (ks < refs_p.shape[1])
     ref_shifted = jnp.where(

@@ -221,3 +221,37 @@ def test_scan_traceback_matches_while_loop():
         np.testing.assert_array_equal(
             np.asarray(a), np.asarray(b).reshape(shp), err_msg=name
         )
+
+
+def test_pileup_pallas_full_width_draft():
+    """Regression: drafts extending into the last band_width columns of the
+    padded width must still produce exact planes (the pre-shifted ref chunk
+    loads previously ran out of the block for ragged L + W)."""
+    from ont_tcrconsensus_tpu.io import simulator
+    from ont_tcrconsensus_tpu.ops import pileup
+
+    rng = np.random.default_rng(21)
+    C, S, W = 2, 4, 256
+    sub = np.full((C, S, W), encode.PAD_CODE, np.uint8)
+    lens = np.zeros((C, S), np.int32)
+    drafts = np.full((C, W), encode.PAD_CODE, np.uint8)
+    dlens = np.zeros((C,), np.int32)
+    for c in range(C):
+        template = simulator._rand_seq(rng, 250)  # within band/2 of W
+        for i in range(S):
+            s, _ = simulator.mutate(rng, template, 0.02, 0.005, 0.005)
+            e = encode.encode_seq(s)[:W]
+            sub[c, i, : len(e)] = e
+            lens[c, i] = len(e)
+        t = encode.encode_seq(template)
+        drafts[c, : len(t)] = t
+        dlens[c] = len(t)
+
+    ref = pileup.pileup_columns_batch(
+        sub, lens, drafts, dlens, band_width=64, out_len=W
+    )
+    got = pileup.pileup_columns_batch_auto(
+        sub, lens, drafts, dlens, band_width=64, out_len=W, force_pallas=True
+    )
+    for a, b, name in zip(ref, got, ("base_at", "ins_cnt", "ins_base", "spans")):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
