@@ -47,3 +47,12 @@ def test_fused_batch_fits_budget():
     m = BudgetModel(hbm_gb=8.0)
     b = m.read_batch(4096, num_refs=1024)
     assert b * m.read_bytes(4096, num_refs=1024) <= m.budget_bytes
+
+
+def test_cluster_batch_lane_cap():
+    """cb * s_bucket never exceeds MAX_POLISH_LANES (pileup dispatch lanes)."""
+    m = BudgetModel(hbm_gb=16.0)
+    for s in (4, 8, 16, 32, 64):
+        cb = m.cluster_batch(s, 2048, 64)
+        assert cb * s <= BudgetModel.MAX_POLISH_LANES, (s, cb)
+        assert (cb & (cb - 1)) == 0
