@@ -25,12 +25,52 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import subprocess
 import sys
 import time
 
 REFERENCE_NODE_READS_PER_SEC = 70e6 / (22 * 3600)  # ~884, BASELINE.md midpoint
 
 NUM_READS_TARGET = 10_000
+
+
+def probe_backend(deadline_sec: float = 900.0, attempt_timeout: float = 300.0) -> bool:
+    """Wait for a usable jax backend BEFORE building the dataset.
+
+    Round-2's capture died with rc=1 because a transient tunnel outage made
+    ``jax.devices()`` raise AFTER minutes of dataset building (VERDICT r2
+    missing #4).  jax caches backend-discovery failures in-process, so each
+    attempt runs in a fresh subprocess; we retry with backoff until the
+    deadline.  Returns True when a backend answered, False when the deadline
+    passed without one.
+    """
+    t0 = time.time()
+    attempt = 0
+    while True:
+        attempt += 1
+        remaining = deadline_sec - (time.time() - t0)
+        if remaining <= 0:
+            return False
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d=jax.devices(); print(d[0].platform)"],
+                capture_output=True, text=True,
+                timeout=min(attempt_timeout, max(remaining, 30.0)),
+            )
+        except subprocess.TimeoutExpired:
+            print(f"bench: backend probe {attempt} timed out", file=sys.stderr)
+            continue
+        if proc.returncode == 0:
+            print(
+                f"bench: backend up ({proc.stdout.strip()}) after "
+                f"{time.time() - t0:.0f}s, attempt {attempt}",
+                file=sys.stderr,
+            )
+            return True
+        tail = (proc.stderr or "").strip().splitlines()[-1:] or ["?"]
+        print(f"bench: backend probe {attempt} failed: {tail[0]}", file=sys.stderr)
+        time.sleep(min(30.0, max(5.0, remaining * 0.05)))
 
 
 def build_dataset(root: str, seed: int = 33):
@@ -118,15 +158,48 @@ def read_stage_timing(root: str) -> dict[str, float]:
     return out
 
 
+def emit(value: float, extra: dict | None = None) -> None:
+    line = {
+        "metric": "pipeline_reads_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "reads/s",
+        "vs_baseline": round(value / REFERENCE_NODE_READS_PER_SEC, 4),
+    }
+    if extra:
+        line.update(extra)
+    print(json.dumps(line))
+
+
 def main():
+    # Probe FIRST so a dead backend yields a diagnosable artifact (rc=0,
+    # "tpu_unavailable") instead of a stack trace after minutes of setup.
+    # BENCH_FORCE_CPU=1 is a dev-only escape hatch for relative timing when
+    # the TPU tunnel is down (the axon plugin overrides JAX_PLATFORMS, so
+    # the config API is the only reliable CPU override — see tests/conftest).
+    if os.environ.get("BENCH_FORCE_CPU"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print("bench: BENCH_FORCE_CPU set; running on host CPU", file=sys.stderr)
+    elif not probe_backend():
+        emit(0.0, {"error": "tpu_unavailable"})
+        return
+
     root = "/tmp/ont_tcr_bench"
     shutil.rmtree(root, ignore_errors=True)
     lib = build_dataset(root)
     n_reads = len(lib.reads)
 
     # warm-up run compiles every kernel; timed run measures steady state
-    _, warm_dt, _ = run_once(root)
-    results, dt, cfg = run_once(root)
+    try:
+        _, warm_dt, _ = run_once(root)
+        results, dt, cfg = run_once(root)
+    except Exception as exc:  # backend died mid-run: still record a JSON line
+        import traceback
+
+        traceback.print_exc()
+        emit(0.0, {"error": f"{type(exc).__name__}: {str(exc)[:200]}"})
+        return
 
     counts_ok = results.get("barcode01") == lib.true_counts
     acc = assignment_accuracy(root, lib)
@@ -147,12 +220,7 @@ def main():
         }
         print(f"bench: count diffs (got, want): {diff}", file=sys.stderr)
     print(f"bench: stage timing {timing}", file=sys.stderr)
-    print(json.dumps({
-        "metric": "pipeline_reads_per_sec_per_chip",
-        "value": round(reads_per_sec, 2),
-        "unit": "reads/s",
-        "vs_baseline": round(reads_per_sec / REFERENCE_NODE_READS_PER_SEC, 4),
-    }))
+    emit(reads_per_sec)
 
 
 if __name__ == "__main__":
