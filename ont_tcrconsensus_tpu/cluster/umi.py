@@ -58,6 +58,7 @@ def cluster_umis(
     kmer_k: int = 4,
     pair_batch: int = 65536,
     pad_width: int = 128,
+    mesh=None,
 ) -> UmiClusters:
     """Cluster combined-UMI strings; returns per-input labels.
 
@@ -92,17 +93,18 @@ def cluster_umis(
         # computes the full identity matrix — exact (no shortlist, so no
         # merge-repair pass) and ~6x fewer dispatches, which dominates cost
         # at this size
-        neigh_idx, neigh_ident = _full_identities(codes, lens)
+        neigh_idx, neigh_ident = _full_identities(codes, lens, mesh=mesh)
         ulabels, centroids = _greedy_assign(order, neigh_idx, neigh_ident, identity_threshold)
     else:
         neigh_idx, neigh_ident = _neighbor_identities(
             codes, lens, shortlist_k=shortlist_k, kmer_k=kmer_k,
-            pair_batch=pair_batch,
+            pair_batch=pair_batch, mesh=mesh,
         )
         ulabels, centroids = _greedy_assign(order, neigh_idx, neigh_ident, identity_threshold)
         ulabels, centroids = _merge_close_centroids(
             ulabels, centroids, codes, lens, identity_threshold,
             shortlist_k=shortlist_k, kmer_k=kmer_k, pair_batch=pair_batch,
+            mesh=mesh,
         )
 
     labels = ulabels[inverse]
@@ -129,7 +131,7 @@ _PAIR_CHUNK = 8192  # fixed device-dispatch shape for the exact-distance pass
 _FULL_MATRIX_MAX = 256
 
 
-def _full_identities(codes, lens):
+def _full_identities(codes, lens, mesh=None):
     """All-vs-all identities in one device dispatch (U <= _FULL_MATRIX_MAX).
 
     Returns (neigh (U, U-1), ident (U, U-1)): every other unique as a
@@ -145,7 +147,7 @@ def _full_identities(codes, lens):
         )
         lens = np.concatenate([lens, np.zeros(U_pad - U, lens.dtype)])
     d = np.asarray(
-        edit_distance.many_vs_many_dovetail(codes, lens, codes, lens)
+        edit_distance.many_vs_many_dovetail_auto(codes, lens, codes, lens, mesh=mesh)
     ).astype(np.float32)[:U, :U]
     longest = np.maximum(lens[:U, None], lens[None, :U]).astype(np.float32)
     ident = 1.0 - d / np.maximum(longest, 1.0)
@@ -161,7 +163,7 @@ def _pow2_ceil(n: int, lo: int = 16) -> int:
     return pow2_ceil(n, lo)
 
 
-def _neighbor_identities(codes, lens, shortlist_k, kmer_k, pair_batch):
+def _neighbor_identities(codes, lens, shortlist_k, kmer_k, pair_batch, mesh=None):
     """(U, K) nearest-unique shortlist + exact identities, device-computed.
 
     Every device call runs on power-of-two padded shapes (U padded with
@@ -210,8 +212,9 @@ def _neighbor_identities(codes, lens, shortlist_k, kmer_k, pair_batch):
     for s in range(0, n_padded, chunk):
         sl = slice(s, s + chunk)
         d = np.asarray(
-            edit_distance.pairwise_dovetail(
-                codes[qi[sl]], lens[qi[sl]], codes[ti[sl]], lens[ti[sl]]
+            edit_distance.pairwise_dovetail_auto(
+                codes[qi[sl]], lens[qi[sl]], codes[ti[sl]], lens[ti[sl]],
+                mesh=mesh,
             )
         ).astype(np.float32)
         longest = np.maximum(lens[qi[sl]], lens[ti[sl]]).astype(np.float32)
@@ -223,7 +226,7 @@ def _neighbor_identities(codes, lens, shortlist_k, kmer_k, pair_batch):
 
 
 def _merge_close_centroids(labels, centroids, codes, lens, threshold,
-                           shortlist_k, kmer_k, pair_batch):
+                           shortlist_k, kmer_k, pair_batch, mesh=None):
     """Repair shortlist misses: no centroid may sit within the identity
     threshold of an earlier-created one.
 
@@ -240,11 +243,11 @@ def _merge_close_centroids(labels, centroids, codes, lens, threshold,
         return labels, centroids
     ccodes, clens = codes[centroids], lens[centroids]
     if C <= _FULL_MATRIX_MAX:
-        neigh, ident = _full_identities(ccodes, clens)
+        neigh, ident = _full_identities(ccodes, clens, mesh=mesh)
     else:
         neigh, ident = _neighbor_identities(
             ccodes, clens, shortlist_k=shortlist_k, kmer_k=kmer_k,
-            pair_batch=pair_batch,
+            pair_batch=pair_batch, mesh=mesh,
         )
     parent = np.arange(C)
 

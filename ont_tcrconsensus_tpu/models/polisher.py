@@ -9,12 +9,20 @@ the same architecture family *in-repo* on the simulator's error model
 architecture (counts features -> stacked bi-GRU -> per-position class head)
 is the medaka design.
 
-Classes per draft position: 0-3 = A/C/G/T, 4 = deletion (position absent
-from the true sequence). Insertions are handled upstream by the vote stage
-(:mod:`..ops.consensus`); the RNN fixes residual substitution/deletion errors
-that majority voting leaves at low depth.
+Two heads per draft position (medaka's insert-column capability, folded
+into one output):
 
-All shapes static: (batch, length, features) -> (batch, length, 5).
+- class head (5): 0-3 = true base A/C/G/T, 4 = deletion (the draft
+  position is absent from the true sequence);
+- insertion head (5): 0 = nothing inserted after this position,
+  1-4 = a base (A/C/G/T) the draft MISSED after this position.
+
+The insertion head is what makes the stage able to fix ONT's dominant
+error — homopolymer run shrinkage — which no substitute/delete-only
+polisher can repair (every subread under-calls the same run, so the vote
+draft is short and the missing base must be re-inserted).
+
+All shapes static: (batch, length, features) -> (batch, length, 10).
 """
 
 from __future__ import annotations
@@ -26,8 +34,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ont_tcrconsensus_tpu.parallel.mesh import mesh_data_size
+
 NUM_CLASSES = 5
-FEATURE_DIM = 11  # see ops.consensus.pileup_features
+NUM_INS_CLASSES = 5   # none / +A / +C / +G / +T
+TOTAL_LOGITS = NUM_CLASSES + NUM_INS_CLASSES
+FEATURE_DIM = 15  # see ops.consensus.pileup_features
 
 
 class BiGRU(nn.Module):
@@ -43,7 +55,7 @@ class BiGRU(nn.Module):
 
 
 class ConsensusPolisher(nn.Module):
-    """medaka-class polisher: Dense -> 2x bi-GRU -> class head."""
+    """medaka-class polisher: Dense -> 2x bi-GRU -> class + insertion heads."""
 
     hidden: int = 96
     num_layers: int = 2
@@ -54,7 +66,7 @@ class ConsensusPolisher(nn.Module):
         x = nn.gelu(x)
         for i in range(self.num_layers):
             x = BiGRU(self.hidden, name=f"bigru{i}")(x)
-        return nn.Dense(NUM_CLASSES, name="head")(x)
+        return nn.Dense(TOTAL_LOGITS, name="head")(x)
 
 
 def init_params(rng_seed: int = 0, length: int = 128) -> dict:
@@ -64,7 +76,7 @@ def init_params(rng_seed: int = 0, length: int = 128) -> dict:
 
 
 def apply_logits(params, feats: jax.Array) -> jax.Array:
-    """(B, L, F) -> (B, L, 5) logits."""
+    """(B, L, F) -> (B, L, 10) logits: [:5] class head, [5:] insertion head."""
     return ConsensusPolisher().apply({"params": params}, feats)
 
 
@@ -73,7 +85,8 @@ def polish_draft(
     depth: np.ndarray | None = None,
     min_confidence: float = 0.9,
 ) -> tuple[np.ndarray, int]:
-    """Apply the polisher to one draft: predicted subs applied, deletions cut.
+    """Apply the polisher to one draft: subs applied, deletions cut,
+    confident insertions spliced in.
 
     Args:
       feats: (L, F) pileup features (ops.consensus.pileup_features).
@@ -85,60 +98,100 @@ def polish_draft(
         nothing, so low-confidence disagreements defer to the vote consensus
         (medaka imposes the same property through sheer training scale).
 
-    Returns (polished codes padded to L, new length).
+    Returns (polished codes padded to 2*L, new length).
     """
     from ont_tcrconsensus_tpu.ops.encode import PAD_CODE
 
     logits = np.asarray(apply_logits(params, jnp.asarray(feats)[None, :, :]))[0]
-    pred = logits.argmax(axis=-1).astype(np.uint8)  # (L,)
-    probs = np.exp(logits - logits.max(axis=-1, keepdims=True))
-    probs /= probs.sum(axis=-1, keepdims=True)
-    confident = probs.max(axis=-1) >= min_confidence
+    cls, ins = logits[:, :NUM_CLASSES], logits[:, NUM_CLASSES:]
+
+    def softmax_conf(lg):
+        p = np.exp(lg - lg.max(axis=-1, keepdims=True))
+        p /= p.sum(axis=-1, keepdims=True)
+        return lg.argmax(axis=-1).astype(np.uint8), p.max(axis=-1)
+
+    pred, conf = softmax_conf(cls)
+    ins_pred, ins_conf = softmax_conf(ins)
     L = draft.shape[0]
     in_draft = np.arange(L) < int(draft_len)
     covered = in_draft if depth is None else (in_draft & (np.asarray(depth) > 0))
-    apply = covered & confident
+    apply = covered & (conf >= min_confidence)
     base = np.where(apply, pred, draft)
     keep = in_draft & ~(apply & (pred == 4))
-    kept = base[keep].astype(np.uint8)
-    out = np.full((L,), PAD_CODE, np.uint8)
+    do_ins = covered & (ins_conf >= min_confidence) & (ins_pred > 0)
+    slot_base = np.stack(
+        [base, np.where(do_ins, ins_pred - 1, 0)], axis=1
+    ).reshape(-1)
+    slot_keep = np.stack([keep, do_ins], axis=1).reshape(-1)
+    kept = slot_base[slot_keep].astype(np.uint8)
+    out = np.full((2 * L,), PAD_CODE, np.uint8)
     out[: kept.size] = kept
     return out, int(kept.size)
 
 
-def _polish_from_pileup(params, base_at, ins_cnt, drafts):
-    """(C,S,W) pileup columns -> (pred, confidence, depth), each (C,W)."""
+def _polish_from_pileup(params, base_at, ins_cnt, ins_base, drafts):
+    """(C,S,W) pileup columns -> (pred, conf, depth, ins_pred, ins_conf)."""
     from ont_tcrconsensus_tpu.ops import consensus as consensus_mod
     from ont_tcrconsensus_tpu.ops import pileup as pileup_mod
 
-    feats = jax.vmap(consensus_mod.pileup_features)(base_at, ins_cnt, drafts)
-    logits = apply_logits(params, feats)  # (C, W, 5)
-    probs = jax.nn.softmax(logits, axis=-1)
-    pred = jnp.argmax(logits, axis=-1).astype(jnp.uint8)
+    feats = jax.vmap(consensus_mod.pileup_features)(
+        base_at, ins_cnt, ins_base, drafts
+    )
+    logits = apply_logits(params, feats)  # (C, W, 10)
+    cls, ins = logits[..., :NUM_CLASSES], logits[..., NUM_CLASSES:]
+    probs = jax.nn.softmax(cls, axis=-1)
+    pred = jnp.argmax(cls, axis=-1).astype(jnp.uint8)
     conf = jnp.max(probs, axis=-1)
+    ins_probs = jax.nn.softmax(ins, axis=-1)
+    ins_pred = jnp.argmax(ins, axis=-1).astype(jnp.uint8)
+    ins_conf = jnp.max(ins_probs, axis=-1)
     depth = jnp.sum(base_at != pileup_mod.UNCOVERED, axis=1)
-    return pred, conf, depth
+    return pred, conf, depth, ins_pred, ins_conf
 
 
-def _device_polish_batch(params, sub, lens, drafts, dlens, band_width):
+def _device_polish_batch(params, sub, lens, drafts, dlens, band_width,
+                         mesh=None):
     """(C,S,W) cluster tile -> (pred (C,W), confidence (C,W), depth (C,W)).
 
     One pileup + one RNN dispatch for the whole tile — the batched medaka
     pass (medaka_polish.py:95-144 analogue, without the per-cluster
-    subprocess fan-out the reference schedules around).
+    subprocess fan-out the reference schedules around). ``mesh`` shards the
+    pileup lanes and the RNN's cluster axis over its ``data`` axis.
     """
     from ont_tcrconsensus_tpu.ops import pileup as pileup_mod
 
-    base_at, ins_cnt, _, _ = pileup_mod.pileup_columns_batch_auto(
-        sub, lens, drafts, dlens, band_width=band_width, out_len=drafts.shape[1]
+    base_at, ins_cnt, ins_base, _ = pileup_mod.pileup_columns_batch_auto(
+        sub, lens, drafts, dlens, band_width=band_width,
+        out_len=drafts.shape[1], mesh=mesh,
     )
-    return _polish_from_pileup(params, base_at, ins_cnt, drafts)
+    if mesh is not None:
+        return _sharded_polish_from_pileup(mesh)(
+            params, base_at, ins_cnt, ins_base, drafts
+        )
+    return _polish_from_pileup_jit(params, base_at, ins_cnt, ins_base, drafts)
 
 
 _device_polish_batch_jit = jax.jit(
     _device_polish_batch, static_argnames=("band_width",)
 )
 _polish_from_pileup_jit = jax.jit(_polish_from_pileup)
+
+
+import functools as _functools  # noqa: E402
+
+
+@_functools.lru_cache(maxsize=None)
+def _sharded_polish_from_pileup(mesh):
+    """Cluster-axis-sharded RNN serving (params replicated; no collectives)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    d = P("data")
+    return jax.jit(shard_map(
+        _polish_from_pileup, mesh=mesh,
+        in_specs=(P(), d, d, d, d), out_specs=(d,) * 5,
+        check_vma=False,
+    ))
 
 
 def make_pipeline_polisher(params, band_width: int | None = None,
@@ -158,21 +211,34 @@ def make_pipeline_polisher(params, band_width: int | None = None,
 
     default_band = POLISH_BAND_WIDTH if band_width is None else band_width
 
-    def polish(sub, lens, drafts, dlens, pileup=None, band_width=None):
+    def polish(sub, lens, drafts, dlens, pileup=None, band_width=None,
+               mesh=None):
         """``band_width`` is forwarded by the polish stage so recomputed
         pileups use the SAME band the consensus rounds (and any reused
         pileup) did — two knobs drifting apart would mix feature scales
-        within one run."""
+        within one run. ``mesh`` shards the serving dispatches on the
+        cluster axis (ignored when C doesn't divide its data axis)."""
+        if mesh is not None and np.asarray(drafts).shape[0] % mesh_data_size(mesh):
+            mesh = None
         if pileup is not None:
-            base_at, ins_cnt = pileup
-            out = _polish_from_pileup_jit(params, base_at, ins_cnt, jnp.asarray(drafts))
+            base_at, ins_cnt, ins_base = pileup
+            fn = (_polish_from_pileup_jit if mesh is None
+                  else _sharded_polish_from_pileup(mesh))
+            out = fn(params, base_at, ins_cnt, ins_base, jnp.asarray(drafts))
+        elif mesh is not None:
+            out = _device_polish_batch(
+                params, jnp.asarray(sub), jnp.asarray(lens),
+                jnp.asarray(drafts), jnp.asarray(dlens),
+                default_band if band_width is None else band_width,
+                mesh=mesh,
+            )
         else:
             out = _device_polish_batch_jit(
                 params, jnp.asarray(sub), jnp.asarray(lens),
                 jnp.asarray(drafts), jnp.asarray(dlens),
                 default_band if band_width is None else band_width,
             )
-        pred, conf, depth = jax.device_get(out)
+        pred, conf, depth, ins_pred, ins_conf = jax.device_get(out)
         drafts = np.asarray(drafts)
         dlens = np.asarray(dlens)
         C, W = drafts.shape
@@ -180,13 +246,23 @@ def make_pipeline_polisher(params, band_width: int | None = None,
         out = np.full_like(drafts, PAD_CODE)
         out_lens = np.zeros_like(dlens)
         in_draft = pos[None, :] < dlens[:, None]
-        apply = in_draft & (depth > 0) & (conf >= min_confidence)
+        covered = in_draft & (depth > 0)
+        apply = covered & (conf >= min_confidence)
         base = np.where(apply, pred, drafts)
         keep = in_draft & ~(apply & (pred == 4))
+        do_ins = covered & (ins_conf >= min_confidence) & (ins_pred > 0)
+        # interleave kept bases with confident insertions (slot 2j = draft
+        # position j, slot 2j+1 = insertion after j), then compact. The
+        # width is fixed: clusters that would overflow W keep their tail
+        # un-inserted (the pileup band already bounds drift well below W).
+        slot_base = np.stack(
+            [base, np.where(do_ins, ins_pred - 1, 0)], axis=2
+        ).reshape(C, 2 * W)
+        slot_keep = np.stack([keep, do_ins], axis=2).reshape(C, 2 * W)
         for c in range(C):
             if dlens[c] == 0:
                 continue
-            kept = base[c][keep[c]].astype(np.uint8)
+            kept = slot_base[c][slot_keep[c]].astype(np.uint8)[:W]
             out[c, : kept.size] = kept
             out_lens[c] = kept.size
         return out, out_lens
@@ -198,18 +274,28 @@ def make_pipeline_polisher(params, band_width: int | None = None,
 # training (in-repo, on the simulator's error model)
 
 
-def cross_entropy_loss(params, feats, labels, mask):
+def cross_entropy_loss(params, feats, labels, ins_labels, mask):
+    """Two-head loss: class (base/del) + insertion, both masked the same."""
     logits = apply_logits(params, feats)
-    logp = jax.nn.log_softmax(logits)
-    ll = jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
-    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    cls, ins = logits[..., :NUM_CLASSES], logits[..., NUM_CLASSES:]
+
+    def ce(lg, lab):
+        logp = jax.nn.log_softmax(lg)
+        ll = jnp.take_along_axis(
+            logp, lab[..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+        return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+    return ce(cls, labels) + ce(ins, ins_labels)
 
 
 def make_train_step(optimizer):
     """Returns a jittable (params, opt_state, batch) -> (params, opt_state, loss)."""
 
-    def train_step(params, opt_state, feats, labels, mask):
-        loss, grads = jax.value_and_grad(cross_entropy_loss)(params, feats, labels, mask)
+    def train_step(params, opt_state, feats, labels, ins_labels, mask):
+        loss, grads = jax.value_and_grad(cross_entropy_loss)(
+            params, feats, labels, ins_labels, mask
+        )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         return params, opt_state, loss
@@ -232,7 +318,7 @@ def load_params(path: str) -> dict:
         return flax.serialization.from_bytes(template, fh.read())
 
 
-DEFAULT_WEIGHTS = os.path.join(os.path.dirname(__file__), "weights", "polisher_v1.msgpack")
+DEFAULT_WEIGHTS = os.path.join(os.path.dirname(__file__), "weights", "polisher_v2.msgpack")
 
 
 def load_default_params() -> dict | None:

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ont_tcrconsensus_tpu.ops import pileup
+from ont_tcrconsensus_tpu.parallel.mesh import mesh_data_size
 from ont_tcrconsensus_tpu.ops.encode import PAD_CODE
 
 # The ONE band width of the polish path — consensus rounds, polisher serving
@@ -189,6 +190,20 @@ def consensus_cluster(
 _vote_columns_batch = jax.jit(jax.vmap(vote_columns))
 
 
+@functools.lru_cache(maxsize=None)
+def _sharded_vote_fn(mesh):
+    """Cluster-axis-sharded :func:`vote_columns` (zero collectives)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    specs = (P("data"),) * 5
+    return jax.jit(shard_map(
+        jax.vmap(vote_columns), mesh=mesh,
+        in_specs=specs, out_specs=(P("data"), P("data")),
+        check_vma=False,
+    ))
+
+
 def _extend_ends_batch(drafts, dlens, subreads, subread_lens, spans,
                        aligned_dlens):
     """Vectorized :func:`_extend_ends` across the cluster axis.
@@ -243,6 +258,7 @@ def consensus_clusters_batch(
     rounds: int = 4,
     band_width: int = POLISH_BAND_WIDTH,
     keep_final_pileup: bool = False,
+    mesh=None,
 ) -> tuple[np.ndarray, np.ndarray] | tuple[np.ndarray, np.ndarray, tuple | None]:
     """Batched :func:`consensus_cluster` over C same-shape clusters.
 
@@ -250,17 +266,22 @@ def consensus_clusters_batch(
       subreads: (C, S, W) uint8 dense codes (0-length rows = padding);
       subread_lens: (C, S).
       keep_final_pileup: also return the last round's device pileup
-        ``(base_at, ins_cnt)`` when it was computed against the FINAL drafts
+        ``(base_at, ins_cnt, ins_base)`` when it was computed against the FINAL drafts
         (i.e. the loop exited via convergence, so the pre-vote drafts equal
         the returned ones) — the RNN polisher consumes exactly that pileup
         and can skip recomputing it. ``None`` when the loop hit the rounds
         cap still changing.
+      mesh: optional jax Mesh — shards the pileup lanes and the vote's
+        cluster axis over its ``data`` axis (C must divide the axis size;
+        otherwise the call silently runs single-device). VERDICT r2 #3.
 
     Returns (drafts (C, W), draft_lens (C,)[, final_pileup]). One device
     dispatch per round covers every cluster — the per-cluster host loop only
     handles seed selection, end extension, and convergence checks.
     """
     C, S, W = subreads.shape
+    if mesh is not None and C % mesh_data_size(mesh) != 0:
+        mesh = None
     subread_lens = np.asarray(subread_lens)
     drafts = np.full((C, W), PAD_CODE, np.uint8)
     dlens = np.zeros((C,), np.int32)
@@ -275,13 +296,14 @@ def consensus_clusters_batch(
         dlens[c] = n
 
     converged = False
-    base_at = ins_cnt = None
+    base_at = ins_cnt = ins_base = None
+    vote_fn = _vote_columns_batch if mesh is None else _sharded_vote_fn(mesh)
     for _ in range(rounds):
         base_at, ins_cnt, ins_base, spans = pileup.pileup_columns_batch_auto(
             subreads, subread_lens, jnp.asarray(drafts), jnp.asarray(dlens),
-            band_width=band_width, out_len=W,
+            band_width=band_width, out_len=W, mesh=mesh,
         )
-        new_drafts, new_lens = _vote_columns_batch(
+        new_drafts, new_lens = vote_fn(
             base_at, ins_cnt, ins_base, jnp.asarray(drafts), jnp.asarray(dlens)
         )
         # one coalesced device->host transfer (per-array readback pays a
@@ -309,29 +331,39 @@ def consensus_clusters_batch(
             break
     if not keep_final_pileup:
         return drafts, dlens
-    final_pileup = (base_at, ins_cnt) if converged else None
+    final_pileup = (base_at, ins_cnt, ins_base) if converged else None
     return drafts, dlens, final_pileup
 
 
 @functools.partial(jax.jit, static_argnames=())
 def pileup_features(
-    base_at: jax.Array, ins_cnt: jax.Array, draft: jax.Array
+    base_at: jax.Array, ins_cnt: jax.Array, ins_base: jax.Array,
+    draft: jax.Array,
 ) -> jax.Array:
-    """(S, Ld) columns -> (Ld, 11) float32 polisher features.
+    """(S, Ld) columns -> (Ld, 15) float32 polisher features.
 
-    Channels: A/C/G/T/del counts (5), insertion-reporting count (1), depth
-    (1), all log1p-scaled; draft base one-hot (4); normalized position-free.
-    Mirrors medaka's counts-matrix feature family (its pileup counts
-    encoding), not its exact layout — our polisher is trained in-repo.
+    Channels: A/C/G/T/del counts (5), per-base inserted-base counts (4 —
+    how many subreads report an insertion STARTING with each base after
+    this position; the evidence the insertion head needs to call WHICH
+    base the draft missed), insertion-reporting count (1), depth (1), all
+    log1p-scaled; draft base one-hot (4). Mirrors medaka's counts-matrix
+    feature family (its pileup counts encoding incl. insert columns), not
+    its exact layout — our polisher is trained in-repo.
     """
     S, Ld = base_at.shape
     covered = base_at != pileup.UNCOVERED
     counts = jnp.stack(
         [jnp.sum(base_at == code, axis=0) for code in range(5)], axis=1
     ).astype(jnp.float32)  # (Ld, 5)
-    ins = jnp.sum((ins_cnt > 0) & covered, axis=0).astype(jnp.float32)[:, None]
+    has_ins = (ins_cnt > 0) & covered
+    ins_counts = jnp.stack(
+        [jnp.sum(has_ins & (ins_base == code), axis=0) for code in range(4)],
+        axis=1,
+    ).astype(jnp.float32)  # (Ld, 4)
+    ins = jnp.sum(has_ins, axis=0).astype(jnp.float32)[:, None]
     depth = jnp.sum(covered, axis=0).astype(jnp.float32)[:, None]
     draft_oh = jax.nn.one_hot(jnp.minimum(draft[:Ld], 4), 4, dtype=jnp.float32)
     return jnp.concatenate(
-        [jnp.log1p(counts), jnp.log1p(ins), jnp.log1p(depth), draft_oh], axis=1
+        [jnp.log1p(counts), jnp.log1p(ins_counts), jnp.log1p(ins),
+         jnp.log1p(depth), draft_oh], axis=1
     )

@@ -25,6 +25,8 @@ vsearch is asserted at the UMI-counts level by the end-to-end tests.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -158,6 +160,67 @@ def many_vs_many_dovetail(queries, q_lens, targets, t_lens, k_end: int = 8):
         )
 
     return jax.vmap(one_q)(queries, q_lens)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_pairwise_dovetail(mesh, k_end: int):
+    """Pair-axis-sharded :func:`pairwise_dovetail` (zero collectives)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    fn = jax.vmap(lambda x, xl, y, yl: _dovetail_pair(x, xl, y, yl, k_end))
+    d1, d2 = P("data"), P("data", None)
+    return jax.jit(shard_map(
+        fn, mesh=mesh, in_specs=(d2, d1, d2, d1), out_specs=d1,
+        check_vma=False,
+    ))
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_mvm_dovetail(mesh, k_end: int):
+    """Query-axis-sharded :func:`many_vs_many_dovetail` (targets replicated)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def fn(queries, q_lens, targets, t_lens):
+        def one_q(q, ql):
+            return jax.vmap(
+                lambda t, tl: _dovetail_pair(q, ql, t, tl, k_end)
+            )(targets, t_lens.astype(jnp.int32))
+
+        return jax.vmap(one_q)(queries, q_lens.astype(jnp.int32))
+
+    d1, d2, rep = P("data"), P("data", None), P()
+    return jax.jit(shard_map(
+        fn, mesh=mesh, in_specs=(d2, d1, rep, rep),
+        out_specs=P("data", None), check_vma=False,
+    ))
+
+
+def pairwise_dovetail_auto(a, a_lens, b, b_lens, k_end: int = 8, mesh=None):
+    """:func:`pairwise_dovetail`, sharded over ``mesh``'s data axis when the
+    pair count divides it (UMI distance chunks; VERDICT r2 #3)."""
+    from ont_tcrconsensus_tpu.parallel.mesh import mesh_data_size
+
+    if mesh is not None and a.shape[0] % mesh_data_size(mesh) == 0:
+        return _sharded_pairwise_dovetail(mesh, k_end)(
+            jnp.asarray(a), jnp.asarray(a_lens, jnp.int32),
+            jnp.asarray(b), jnp.asarray(b_lens, jnp.int32),
+        )
+    return pairwise_dovetail(a, a_lens, b, b_lens, k_end)
+
+
+def many_vs_many_dovetail_auto(queries, q_lens, targets, t_lens,
+                               k_end: int = 8, mesh=None):
+    """:func:`many_vs_many_dovetail`, query-axis-sharded when possible."""
+    from ont_tcrconsensus_tpu.parallel.mesh import mesh_data_size
+
+    if mesh is not None and queries.shape[0] % mesh_data_size(mesh) == 0:
+        return _sharded_mvm_dovetail(mesh, k_end)(
+            jnp.asarray(queries), jnp.asarray(q_lens, jnp.int32),
+            jnp.asarray(targets), jnp.asarray(t_lens, jnp.int32),
+        )
+    return many_vs_many_dovetail(queries, q_lens, targets, t_lens, k_end)
 
 
 # k-mer profile prefilters live in :mod:`.sketch` (exact mode: dim=None).

@@ -27,6 +27,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ont_tcrconsensus_tpu.parallel.mesh import mesh_data_size
 from ont_tcrconsensus_tpu.ops.sw_align import (
     GAP_EXT,
     GAP_OPEN,
@@ -405,6 +406,33 @@ def _traceback_batch(best, planes, reads, band_width: int, out_len: int):
     return base_at, ins_cnt, ins_base, spans
 
 
+@functools.lru_cache(maxsize=None)
+def _sharded_pileup_fn(mesh, band_width: int, out_len: int):
+    """shard_map-wrapped forward+traceback over the flat lane axis.
+
+    The polish stage is embarrassingly parallel over alignment lanes
+    (cluster x subread), so each chip runs the exact single-chip program on
+    its lane shard with zero collectives — the same recipe as the fused read
+    pass (pipeline/assign.py) and the TPU mapping of the reference's
+    node-wide medaka fan-out (ref medaka_polish.py:95-144; VERDICT r2 #3).
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def base(reads, rlens, refs, reflens):
+        best, planes = _forward_batch(
+            reads, rlens, refs, reflens, band_width=band_width
+        )
+        return _traceback_batch(best, planes, reads, band_width, out_len)
+
+    d1, d2 = P("data"), P("data", None)
+    return jax.jit(shard_map(
+        base, mesh=mesh, in_specs=(d2, d1, d2, d1),
+        out_specs=(d2, d2, d2, d2),
+        check_vma=False,
+    ))
+
+
 def pileup_columns_batch_auto(
     subreads,
     subread_lens,
@@ -413,6 +441,7 @@ def pileup_columns_batch_auto(
     band_width: int = 128,
     out_len: int | None = None,
     force_pallas: bool = False,
+    mesh=None,
 ):
     """:func:`pileup_columns_batch` split into flat-lane forward + scan-log
     traceback — the production pileup path.
@@ -426,17 +455,25 @@ def pileup_columns_batch_auto(
     (:mod:`.pileup_pallas`; interpreter on CPU) — the equivalence-test hook
     for that kernel, which currently trails the XLA forward on the tunneled
     chip and is kept as groundwork, not the default.
+
+    ``mesh`` shards the flat lane axis over the mesh's ``data`` axis
+    (lanes = C*S must divide it; callers pad the cluster axis) — the polish
+    stage's multi-chip path (VERDICT r2 #3).
     """
     if out_len is None:
         out_len = drafts.shape[-1]
     on_cpu = jax.default_backend() == "cpu"
-    if on_cpu and not force_pallas:
+    C, S, L = subreads.shape
+    lanes = C * S
+    use_mesh = (
+        mesh is not None and not force_pallas
+        and lanes % mesh_data_size(mesh) == 0
+    )
+    if on_cpu and not force_pallas and not use_mesh:
         return pileup_columns_batch(
             subreads, subread_lens, drafts, draft_lens,
             band_width=band_width, out_len=out_len,
         )
-    C, S, L = subreads.shape
-    lanes = C * S
     reads = jnp.asarray(subreads).reshape(lanes, L)
     rlens = jnp.asarray(subread_lens).reshape(lanes)
     refs = jnp.repeat(jnp.asarray(drafts), S, axis=0)
@@ -449,13 +486,20 @@ def pileup_columns_batch_auto(
             interpret=on_cpu,
         )
         planes = tdir.astype(jnp.uint16) | (fjump.astype(jnp.uint16) << 4)
+        base_at, ins_cnt, ins_base, spans = _traceback_batch(
+            best, planes, reads, band_width, out_len
+        )
+    elif use_mesh:
+        base_at, ins_cnt, ins_base, spans = _sharded_pileup_fn(
+            mesh, band_width, out_len
+        )(reads, rlens.astype(jnp.int32), refs, reflens)
     else:
         best, planes = _forward_batch(
             reads, rlens, refs, reflens, band_width=band_width
         )
-    base_at, ins_cnt, ins_base, spans = _traceback_batch(
-        best, planes, reads, band_width, out_len
-    )
+        base_at, ins_cnt, ins_base, spans = _traceback_batch(
+            best, planes, reads, band_width, out_len
+        )
     return (
         base_at.reshape(C, S, out_len),
         ins_cnt.reshape(C, S, out_len),

@@ -181,10 +181,17 @@ def forward_planes_pallas(
       tdir/fjump: (N, L, W) uint8 planes.
     """
     N0, L = reads.shape
-    if L % 8:
+    if L % 128:
         raise ValueError(
-            f"read width {L} must be a multiple of 8 (the kernel writes "
-            "direction planes in aligned 8-row groups)"
+            f"read width {L} must be a multiple of 128: elem_at() loads "
+            "128-aligned lane chunks from the (BLK, L) read block, so any "
+            "ragged tail sends the last chunk load out of the block "
+            "(pad_batch pads to multiples of 128 upstream)"
+        )
+    if band_width not in (64, 128):
+        raise ValueError(
+            f"band_width {band_width} unsupported: the kernel's band window "
+            "advance assumes a 64- or 128-lane tile"
         )
     W = band_width
     c = W // 2

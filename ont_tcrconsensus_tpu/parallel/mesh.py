@@ -46,6 +46,12 @@ def make_mesh(shape: dict[str, int] | None = None, devices=None) -> Mesh:
     return Mesh(arr, names)
 
 
+def mesh_data_size(mesh: Mesh) -> int:
+    """Size of the mesh's ``data`` axis (the one shared helper for every
+    divisibility check before a shard_map dispatch)."""
+    return dict(zip(mesh.axis_names, mesh.devices.shape))["data"]
+
+
 def data_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
     """Shard the leading (batch) axis over the data axis; rest replicated."""
     return NamedSharding(mesh, P("data", *([None] * (ndim - 1))))
@@ -101,10 +107,11 @@ def sharded_train_step(mesh: Mesh, optimizer):
     def place_params(params):
         return jax.device_put(params, polisher_param_sharding(mesh, params))
 
-    def place_batch(feats, labels, mask):
+    def place_batch(feats, labels, ins_labels, mask):
         return (
             jax.device_put(feats, data_sharding(mesh, 3)),
             jax.device_put(labels, data_sharding(mesh, 2)),
+            jax.device_put(ins_labels, data_sharding(mesh, 2)),
             jax.device_put(mask, data_sharding(mesh, 2)),
         )
 

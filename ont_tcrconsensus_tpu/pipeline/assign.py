@@ -174,6 +174,18 @@ def _fused_pass(
     # trimmed-span start in the oriented frame (revcomp flips the span)
     t_start_o = jnp.where(is_rev, lens - t_end, t_start)
 
+    # Adapter/primer bases outside the virtual-trim span are masked to the
+    # pad sentinel before SW: they then never match (local alignment
+    # soft-clips them), so score/blast_id/ref spans cover only the trimmed
+    # read — the error-profile stage later aligns the trimmed read against
+    # the stored ref span and would otherwise count adapter-aligned
+    # reference bases as deletions (ADVICE r2).
+    pos_full = jnp.arange(W, dtype=jnp.int32)[None, :]
+    in_span = (pos_full >= t_start_o[:, None]) & (
+        pos_full < (t_start_o + lens_t)[:, None]
+    )
+    oriented_sw = jnp.where(in_span, oriented, jnp.uint8(sw_pallas.PAD_SENTINEL))
+
     # --- banded SW vs each candidate; keep the best score ---
     best = None
     for c in range(top_k):
@@ -181,7 +193,7 @@ def _fused_pass(
         rl = jnp.take(ref_lens, ridx)
         offs = (-t_start_o - ((lens_t - rl) // 2)).astype(jnp.int32)
         res = sw_pallas.align_banded_auto(
-            oriented, lens, jnp.take(ref_codes, ridx, axis=0), rl, offs,
+            oriented_sw, lens, jnp.take(ref_codes, ridx, axis=0), rl, offs,
             band_width=band_width,
         )
         cur = {

@@ -152,6 +152,17 @@ def run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]:
             json.dump(homology.region_cluster, fh, indent=4)
         with open(os.path.join(nano_dir, "self_homology_stats.json"), "w") as fh:
             json.dump(homology.stats, fh, indent=4)
+        # region -> [blast ids of its most-similar partners]; the analysis
+        # layer's most-similar overlay input (ref analysis.py:697-716 reads
+        # the same-named artifact of region_split.py:139-147)
+        most_similar: dict[str, list[float]] = {}
+        for qname, tname, bid in homology.most_similar:
+            most_similar.setdefault(qname, []).append(bid)
+            most_similar.setdefault(tname, []).append(bid)
+        with open(os.path.join(
+            nano_dir, "ref_homology_out_most_similar_region_dict.json"
+        ), "w") as fh:
+            json.dump(most_similar, fh, indent=4)
         artifacts.write_self_homology_log(
             homology.stats,
             os.path.join(nano_dir, "ref_homology_out_generate_region_split_dict.log"),
@@ -341,6 +352,7 @@ def _run_library(fastq, lay, cfg, panel, engine, engine_notrim,
         try:
             sel = _round1_select(
                 group_name, groups[cluster_key], store, lay, cfg, timer,
+                mesh=engine.mesh,
             )
             if sel:
                 selected_by_group.append((group_name, sel))
@@ -357,6 +369,7 @@ def _run_library(fastq, lay, cfg, panel, engine, engine_notrim,
             polisher=polisher,
             budget=budget,
             cluster_batch=cfg.cluster_batch_size,
+            mesh=engine.mesh,
         )
     merged_consensus: list[tuple[str, str]] = []
     for group_name, _ in selected_by_group:
@@ -387,7 +400,7 @@ def _run_library(fastq, lay, cfg, panel, engine, engine_notrim,
 
 
 def _round1_select(group_name, parts, store, lay, cfg,
-                   timer) -> list[stages.SelectedCluster]:
+                   timer, mesh=None) -> list[stages.SelectedCluster]:
     """UMI cluster -> subread select for one region cluster (polish is
     batched library-wide afterwards, stages.polish_clusters_all)."""
     with timer.stage("round1_umi_records"):
@@ -408,6 +421,7 @@ def _round1_select(group_name, parts, store, lay, cfg,
             min_reads_per_cluster=cfg.min_reads_per_cluster,
             max_reads_per_cluster=cfg.max_reads_per_cluster,
             balance_strands=cfg.balance_strands,
+            mesh=mesh,
         )
     cdir = os.path.join(lay.clustering, group_name)
     os.makedirs(cdir, exist_ok=True)
@@ -473,7 +487,8 @@ def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
     for region, parts in sorted(region_groups.items()):
         try:
             _round2_region(region, parts, cons_store, lay, cfg, timer,
-                           region_counts, region_cluster_umis)
+                           region_counts, region_cluster_umis,
+                           mesh=engine_notrim.mesh)
         except Exception as exc:
             failed_regions.append((region, repr(exc)))
             _log(f"WARNING: round-2 region {region} failed and is skipped: {exc!r}")
@@ -504,7 +519,7 @@ def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
 
 
 def _round2_region(region, parts, cons_store, lay, cfg, timer,
-                   region_counts, region_cluster_umis) -> None:
+                   region_counts, region_cluster_umis, mesh=None) -> None:
     """Round-2 dedup clustering + counting for one exact region."""
     with timer.stage("round2_umi_records"):
         umis = stages.build_umi_records(cons_store, parts, cfg.max_pattern_dist)
@@ -526,6 +541,7 @@ def _round2_region(region, parts, cons_store, lay, cfg, timer,
             min_reads_per_cluster=1,
             max_reads_per_cluster=cfg.max_reads_per_cluster,
             balance_strands=False,
+            mesh=mesh,
         )
     rdir = os.path.join(lay.clustering_consensus, f"region_{region}")
     os.makedirs(rdir, exist_ok=True)

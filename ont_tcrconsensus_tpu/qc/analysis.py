@@ -108,6 +108,18 @@ def filter_counts_on_log_umi_count_threshold(
     }
 
 
+def filter_counts_on_umi_quantile_threshold(
+    counts: dict[str, int], quantile_umi_threshold: float = 0.05
+) -> dict[str, int]:
+    """Keep regions whose count exceeds the q-quantile of all counts
+    (analysis.py:565-570: strict >, quantile over the full Count column)."""
+    if not counts:
+        return {}
+    bar = float(np.quantile(np.asarray(list(counts.values()), np.float64),
+                            quantile_umi_threshold))
+    return {region: c for region, c in counts.items() if c > bar}
+
+
 def negative_control_counts(
     counts: dict[str, int],
     suffixes: tuple[str, ...] = ("_v_n", "cdr3j_n", "full_n"),
@@ -323,6 +335,118 @@ def plot_umi_count_hist(counts: dict[str, int], out_path: str,
         )
     ax.legend()
     _savefig(fig, out_path)
+
+
+def plot_percent_alignments_above_blast_id(
+    region_blast_rows: list[tuple[str, float]],
+    out_path: str,
+    minimal_blast_id: float | None = None,
+    quantile_95_blast_id: float | None = None,
+    percent_correct_overlap_length: float | None = None,
+):
+    """Percent-of-alignments blast-id histogram in the precision band
+    (analysis.py:328-390: 0.0001-wide bins over [0.995, 1.0], bar heights
+    as % of all alignments, red/blue threshold lines for the all-TCR and
+    95%-of-TCR precision bars)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    vals = np.asarray([b for _, b in region_blast_rows], np.float64)
+    bins = np.arange(0.995, 1.0002, 0.0001)
+    hist, edges = np.histogram(vals, bins=bins)
+    pct = (hist / max(len(vals), 1)) * 100.0
+    fig, ax = plt.subplots(figsize=(8, 4))
+    ax.bar(edges[:-1], pct, width=np.diff(edges), color="black", alpha=0.25,
+           edgecolor="none", align="edge")
+    if minimal_blast_id is not None:
+        ax.axvline(minimal_blast_id, color="red", linewidth=0.75,
+                   label="Required minimal blast identity\nto distinguish all TCRs")
+    if quantile_95_blast_id is not None:
+        ax.axvline(quantile_95_blast_id, color="blue", linewidth=0.75,
+                   label="Required minimal blast identity\nto distinguish 95% of all TCRs")
+    ax.set_xlim(0.995, 1.001)
+    ax.set_xlabel("Blast identity with reference", fontsize=8)
+    ax.set_ylabel("% of all TCR alignments\nwith correct overlap length", fontsize=8)
+    if percent_correct_overlap_length is not None:
+        ax.set_title(
+            f"{round(percent_correct_overlap_length, 2)}% of all TCR alignments"
+            "\nhave correct overlap length", fontsize=8,
+        )
+    if minimal_blast_id is not None or quantile_95_blast_id is not None:
+        ax.legend(fontsize=8, loc="center left", bbox_to_anchor=(1, 0.5))
+    _savefig(fig, out_path)
+
+
+def plot_log_transformed_umi_counts_hist(
+    counts: dict[str, int],
+    out_path: str,
+    most_similar_regions: set[str] | None = None,
+    log_umi_counts_filter_threshold: float | None = None,
+    plot_normal_dist_fit: bool = True,
+    plot_percentiles: bool = True,
+    title: str | None = None,
+) -> dict[str, float]:
+    """Log-transformed UMI-count histogram with normal fit + percentile
+    lines (analysis.py:660-811). ``most_similar_regions`` overlays the
+    near-homolog subset (the reference filters its most-similar-region dict
+    at blast id > 0.99925); the title carries the log10 95th/5th percentile
+    spread like the reference. Returns the fit stats."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    from scipy import stats as sps
+
+    pos = {r: c for r, c in counts.items() if c > 0}
+    logs = np.log(np.asarray(list(pos.values()), np.float64))
+    out: dict[str, float] = {"n": float(logs.size)}
+    fig, ax = plt.subplots(figsize=(7, 4))
+    if logs.size:
+        xmax = float(logs.max()) * 1.1 + 0.5
+        bins = np.arange(0, xmax, max(xmax / 40, 0.05))
+        ax.hist(logs, bins=bins, density=True, alpha=0.25, color="black",
+                edgecolor="none", zorder=4, label="All TCRs")
+        if most_similar_regions:
+            sim = np.log(np.asarray(
+                [c for r, c in pos.items() if r in most_similar_regions],
+                np.float64,
+            ))
+            if sim.size:
+                ax.hist(sim, bins=bins, density=True, alpha=0.25, color="red",
+                        edgecolor="none", zorder=4, label="Most similar TCRs")
+        if log_umi_counts_filter_threshold is not None:
+            ax.axvline(log_umi_counts_filter_threshold, color="orange",
+                       zorder=6, label="Filter threshold")
+        if plot_percentiles:
+            ax.axvline(np.quantile(logs, 0.05), color="yellow", zorder=6,
+                       label="5th percentile")
+            ax.axvline(np.median(logs), color="blue", zorder=6, label="median")
+            ax.axvline(np.quantile(logs, 0.95), color="black", zorder=6,
+                       label="95th percentile")
+        spread = float(
+            np.log10(np.quantile(list(pos.values()), 0.95))
+            - np.log10(np.quantile(list(pos.values()), 0.05))
+        )
+        out["log10_diff_95th_5th"] = round(spread, 2)
+        ax.set_title(
+            f"{title or ''}\nlog10 diff. 95th vs 5th percentile = "
+            f"{round(spread, 2)}", fontsize=8,
+        )
+        if plot_normal_dist_fit and logs.size >= 3:
+            mean, std = float(logs.mean()), float(logs.std())
+            ks = sps.kstest(logs, "norm", args=(mean, max(std, 1e-9)))
+            out["ks_normal_stat"] = float(ks.statistic)
+            out["ks_normal_p"] = float(ks.pvalue)
+            x = np.linspace(logs.min(), logs.max(), 100)
+            ax.plot(x, sps.norm.pdf(x, mean, std), "r-",
+                    label="Fitted\nNormal Distribution")
+    ax.set_xlabel("log(TCR UMI counts)", fontsize=8)
+    ax.set_ylabel("Density", fontsize=8)
+    ax.legend(fontsize=7, loc="center left", bbox_to_anchor=(1, 0.5))
+    _savefig(fig, out_path)
+    return out
 
 
 _PLATE_ROWS = "ABCDEFGHIJKLMNOP"  # 384-well plate: 16 rows x 24 columns
@@ -543,6 +667,30 @@ def run_library_analysis(
     if os.path.exists(blast_csv):
         rows = read_two_column_csv(blast_csv)
         plot_blast_id_hist(rows, os.path.join(out_dir, "blast_id_hist.pdf"))
+        # precision-band percent hist (analysis.py:328-390): thresholds from
+        # the filter log + the run-level self-homology log
+        flog = os.path.join(logs, "merged_consensus_bam_filter.log")
+        fstats = (
+            parse_merged_consensus_bam_filter_log(flog)
+            if os.path.exists(flog) else {}
+        )
+        pct = None
+        if fstats.get("n_primary"):
+            pct = 100.0 * fstats.get("n_correct_len", 0) / fstats["n_primary"]
+        hlog = os.path.join(
+            os.path.dirname(library_dir),
+            "ref_homology_out_generate_region_split_dict.log",
+        )
+        q95 = (
+            parse_quantile_95_blast_id_from_self_homology_log(hlog)
+            if os.path.exists(hlog) else None
+        )
+        plot_percent_alignments_above_blast_id(
+            rows, os.path.join(out_dir, "precision_blast_id_hist.pdf"),
+            minimal_blast_id=fstats.get("blast_id_threshold"),
+            quantile_95_blast_id=q95,
+            percent_correct_overlap_length=pct,
+        )
     short_csv = os.path.join(logs, "merged_consensus_region_nt_too_short.csv")
     long_csv = os.path.join(logs, "merged_consensus_region_nt_too_long.csv")
     if os.path.exists(short_csv) and os.path.exists(long_csv):
@@ -567,6 +715,26 @@ def run_library_analysis(
                 )
     plot_umi_count_hist(counts, os.path.join(out_dir, "umi_count_hist.pdf"),
                         log10_threshold=log10_threshold)
+    # log-transformed hist with the most-similar overlay (analysis.py:660-811)
+    most_similar_json = os.path.join(
+        os.path.dirname(library_dir),
+        "ref_homology_out_most_similar_region_dict.json",
+    )
+    most_similar: set[str] | None = None
+    if os.path.exists(most_similar_json):
+        import json as _json
+
+        with open(most_similar_json) as fh:
+            sim_map = _json.load(fh)
+        most_similar = {
+            region for region, bids in sim_map.items()
+            if bids and max(bids) > 0.99925
+        }
+    plot_log_transformed_umi_counts_hist(
+        counts, os.path.join(out_dir, "log_transformed_umi_counts_hist.pdf"),
+        most_similar_regions=most_similar,
+        log_umi_counts_filter_threshold=log10_threshold,
+    )
     plot_plate_heatmap(counts, os.path.join(out_dir, "plate_heatmap.pdf"),
                        reference_regions=reference_regions)
     if tcr_refs:

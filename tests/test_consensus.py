@@ -137,9 +137,10 @@ def test_pileup_features_shape():
     base_at, ins_cnt, ins_base = _pile_one(draft, draft, width=128)
     feats = consensus.pileup_features(
         np.asarray(base_at)[None, :], np.asarray(ins_cnt)[None, :],
+        np.asarray(ins_base)[None, :],
         _pad(encode.encode_seq(draft), 128),
     )
-    assert feats.shape == (128, 11)
+    assert feats.shape == (128, 15)
     assert bool(np.isfinite(np.asarray(feats)).all())
 
 

@@ -11,7 +11,7 @@ def test_forward_shapes():
     params = polisher.init_params(0)
     feats = np.zeros((2, 64, polisher.FEATURE_DIM), np.float32)
     logits = np.asarray(polisher.apply_logits(params, feats))
-    assert logits.shape == (2, 64, polisher.NUM_CLASSES)
+    assert logits.shape == (2, 64, polisher.TOTAL_LOGITS)
     assert np.isfinite(logits).all()
 
 
@@ -20,6 +20,7 @@ def test_examples_are_consistent():
     assert ex.feats.shape[0] == 4
     assert ex.feats.shape[2] == polisher.FEATURE_DIM
     assert set(np.unique(ex.labels)).issubset(set(range(5)))
+    assert set(np.unique(ex.ins_labels)).issubset(set(range(5)))
     # supervised positions exist and sit within the draft
     assert ex.mask.sum() > 100
 
@@ -38,7 +39,7 @@ def test_polish_draft_identity_when_confident():
     )
     ex = train.make_examples(seed=7, n_examples=8, template_len=128, width=256)
     logits = np.asarray(polisher.apply_logits(params, ex.feats))
-    pred = logits.argmax(-1)
+    pred = logits[..., : polisher.NUM_CLASSES].argmax(-1)
     m = ex.mask > 0
     acc = (pred[m] == ex.labels[m]).mean()
     assert acc > 0.97, acc

@@ -82,6 +82,36 @@ def test_count_transforms_and_fits():
     assert fits["ks_nbinom_p"] > 0.01
 
 
+def test_quantile_threshold_filter():
+    counts = {f"r{i}": i + 1 for i in range(20)}  # 1..20
+    kept = analysis.filter_counts_on_umi_quantile_threshold(counts, 0.25)
+    # quantile(1..20, .25) = 5.75 -> strictly greater keeps 6..20
+    assert set(kept) == {f"r{i}" for i in range(5, 20)}
+    assert analysis.filter_counts_on_umi_quantile_threshold({}, 0.5) == {}
+
+
+def test_precision_and_log_hist_plots(tmp_path):
+    rows = [("TCR1", 0.9995), ("TCR1", 1.0), ("TCR2", 0.9991)] * 5
+    analysis.plot_percent_alignments_above_blast_id(
+        rows, str(tmp_path / "p.pdf"),
+        minimal_blast_id=0.9992, quantile_95_blast_id=0.999,
+        percent_correct_overlap_length=98.4,
+    )
+    assert (tmp_path / "p.pdf").exists()
+    rng = np.random.default_rng(1)
+    counts = {f"r{i}": int(c) for i, c in enumerate(
+        np.exp(rng.normal(3.0, 0.5, 300)).astype(int) + 1
+    )}
+    stats = analysis.plot_log_transformed_umi_counts_hist(
+        counts, str(tmp_path / "lg.pdf"),
+        most_similar_regions={"r0", "r1"},
+        log_umi_counts_filter_threshold=1.5,
+    )
+    assert (tmp_path / "lg.pdf").exists()
+    assert stats["ks_normal_p"] > 0.001  # lognormal counts fit a normal in log
+    assert "log10_diff_95th_5th" in stats
+
+
 def test_precision_at_num_subreads():
     rows = [("4", 1.0), ("4", 0.999), ("8", 1.0), ("8", 1.0), ("x", 1.0)]
     est = analysis.estimate_precision_at_num_subreads(rows)
@@ -119,7 +149,9 @@ def test_library_analysis_pdfs(tmp_path):
     outs = os.listdir(lib / "outs")
     for pdf in ("blast_id_hist.pdf", "umi_count_hist.pdf", "plate_heatmap.pdf",
                 "subreads_per_umi.pdf", "blast_id_vs_subreads.pdf",
-                "nt_length_deviation.pdf", "results_summary.txt"):
+                "nt_length_deviation.pdf", "results_summary.txt",
+                "precision_blast_id_hist.pdf",
+                "log_transformed_umi_counts_hist.pdf"):
         assert pdf in outs, pdf
     assert summary["sensitivity"] == 1.0
 
