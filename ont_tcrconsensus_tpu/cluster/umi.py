@@ -51,6 +51,37 @@ class UmiClusters:
         return np.where(self.labels == cluster_id)[0]
 
 
+def _dedup(umis: list[str]) -> tuple[list[str], np.ndarray]:
+    """Collapse exact duplicates; returns (uniques, inverse)."""
+    first_idx: dict[str, int] = {}
+    uniq: list[str] = []
+    inverse = np.zeros(len(umis), dtype=np.int32)
+    for i, u in enumerate(umis):
+        j = first_idx.get(u)
+        if j is None:
+            j = len(uniq)
+            first_idx[u] = j
+            uniq.append(u)
+        inverse[i] = j
+    return uniq, inverse
+
+
+def _finish(ulabels, centroids, inverse, N: int) -> UmiClusters:
+    """Map unique-level labels/centroids back to input indices."""
+    labels = ulabels[inverse]
+    U = int(inverse.max()) + 1 if N else 0
+    uniq_to_input = np.full(U, -1, dtype=np.int32)
+    for i in range(N):
+        j = inverse[i]
+        if uniq_to_input[j] < 0:
+            uniq_to_input[j] = i
+    return UmiClusters(
+        labels=labels.astype(np.int32),
+        num_clusters=int(labels.max()) + 1 if N else 0,
+        centroid_of=uniq_to_input[centroids],
+    )
+
+
 def cluster_umis(
     umis: list[str],
     identity_threshold: float,
@@ -69,17 +100,7 @@ def cluster_umis(
     if N == 0:
         return UmiClusters(np.zeros(0, np.int32), 0, np.zeros(0, np.int32))
 
-    # 1. collapse exact duplicates
-    first_idx: dict[str, int] = {}
-    uniq: list[str] = []
-    inverse = np.zeros(N, dtype=np.int32)
-    for i, u in enumerate(umis):
-        j = first_idx.get(u)
-        if j is None:
-            j = len(uniq)
-            first_idx[u] = j
-            uniq.append(u)
-        inverse[i] = j
+    uniq, inverse = _dedup(umis)
     U = len(uniq)
 
     codes, lens = encode.encode_batch(uniq, pad_to=pad_width)
@@ -107,18 +128,130 @@ def cluster_umis(
             mesh=mesh,
         )
 
-    labels = ulabels[inverse]
-    # map centroid unique-indices back to their first occurrence in the input
-    uniq_to_input = np.full(U, -1, dtype=np.int32)
-    for i in range(N):
-        j = inverse[i]
-        if uniq_to_input[j] < 0:
-            uniq_to_input[j] = i
-    return UmiClusters(
-        labels=labels.astype(np.int32),
-        num_clusters=int(labels.max()) + 1 if N else 0,
-        centroid_of=uniq_to_input[centroids],
-    )
+    return _finish(ulabels, centroids, inverse, N)
+
+
+def cluster_umis_grouped(
+    umi_groups: list[list[str]],
+    identity_threshold: float,
+    shortlist_k: int = 32,
+    kmer_k: int = 4,
+    pair_batch: int = 65536,
+    pad_width: int = 128,
+    mesh=None,
+) -> list[UmiClusters]:
+    """Cluster MANY independent UMI sets with a handful of device dispatches.
+
+    The pipeline clusters UMIs once per region cluster (round 1) and once
+    per region (round 2) — dozens to hundreds of small independent calls,
+    each paying dispatch latency (decisive over a tunneled TPU). This
+    batches them: one global unique set, ONE shortlist + exact-distance
+    pass over all groups together, then per-group host-side component
+    assignment. Cross-group identities are masked to -1 before any edge is
+    formed, so results are exactly per-group. The shortlist needs no
+    group-awareness: same-molecule variants (the >=0.93 pairs) always
+    outrank random UMIs in k-mer dot product, whichever group those random
+    UMIs come from.
+
+    Returns one :class:`UmiClusters` per input group, identical to calling
+    :func:`cluster_umis` per group whenever the per-group shortlist would
+    have found the same >=threshold neighbors (asserted by tests).
+    """
+    n_groups = len(umi_groups)
+    results: list[UmiClusters | None] = [None] * n_groups
+
+    # dedup per group, concatenate uniques
+    g_uniq: list[list[str]] = []
+    g_inv: list[np.ndarray] = []
+    offsets = [0]
+    for umis in umi_groups:
+        uniq, inverse = _dedup(umis)
+        g_uniq.append(uniq)
+        g_inv.append(inverse)
+        offsets.append(offsets[-1] + len(uniq))
+    U_all = offsets[-1]
+    if U_all == 0:
+        return [
+            UmiClusters(np.zeros(0, np.int32), 0, np.zeros(0, np.int32))
+            for _ in umi_groups
+        ]
+    all_uniq = [u for uniq in g_uniq for u in uniq]
+    gid = np.zeros(U_all, np.int32)
+    for g in range(n_groups):
+        gid[offsets[g]:offsets[g + 1]] = g
+    codes, lens = encode.encode_batch(all_uniq, pad_to=pad_width)
+
+    def masked_neighbors(codes, lens, gid):
+        """Global neighbor lists with cross-group identities forced to -1."""
+        U = codes.shape[0]
+        if U == 1:
+            return np.zeros((1, 0), np.int32), np.zeros((1, 0), np.float32)
+        if U <= _FULL_MATRIX_MAX:
+            neigh, ident = _full_identities(codes, lens, mesh=mesh)
+        else:
+            neigh, ident = _neighbor_identities(
+                codes, lens, shortlist_k=shortlist_k, kmer_k=kmer_k,
+                pair_batch=pair_batch, mesh=mesh,
+            )
+        ident = np.where(gid[neigh] == gid[:, None], ident, -1.0)
+        return neigh, ident
+
+    neigh, ident = masked_neighbors(codes, lens, gid)
+    used_shortlist = U_all > _FULL_MATRIX_MAX
+
+    def local_rows(neigh, ident, s, e):
+        """Remap global neighbor rows [s:e) to group-local indices (cross-
+        group entries point at local 0 with ident already -1)."""
+        nl = neigh[s:e] - s
+        il = ident[s:e]
+        out_of_group = (nl < 0) | (nl >= e - s)
+        nl = np.where(out_of_group, 0, nl).astype(np.int32)
+        il = np.where(out_of_group, -1.0, il)
+        return nl, il
+
+    # per-group greedy assignment (host only)
+    per_group: list[tuple[np.ndarray, np.ndarray]] = []
+    for g in range(n_groups):
+        s, e = offsets[g], offsets[g + 1]
+        Ug = e - s
+        if Ug == 0:
+            per_group.append((np.zeros(0, np.int32), np.zeros(0, np.int32)))
+            continue
+        if Ug == 1:
+            per_group.append((np.zeros(1, np.int32), np.array([0], np.int32)))
+            continue
+        nl, il = local_rows(neigh, ident, s, e)
+        order = sorted(range(Ug), key=lambda u: (-len(g_uniq[g][u]), u))
+        labels_g, cents_g = _greedy_assign(order, nl, il, identity_threshold)
+        per_group.append((labels_g, cents_g))
+
+    if used_shortlist:
+        # batched merge-repair: ONE neighbor pass over all groups' centroids
+        cent_global = np.concatenate([
+            per_group[g][1] + offsets[g] for g in range(n_groups)
+        ]).astype(np.int32)
+        c_offsets = [0]
+        for g in range(n_groups):
+            c_offsets.append(c_offsets[-1] + len(per_group[g][1]))
+        c_gid = gid[cent_global]
+        c_neigh, c_ident = masked_neighbors(
+            codes[cent_global], lens[cent_global], c_gid
+        )
+        for g in range(n_groups):
+            s, e = c_offsets[g], c_offsets[g + 1]
+            if e - s <= 1:
+                continue
+            nl, il = local_rows(c_neigh, c_ident, s, e)
+            labels_g, cents_g = per_group[g]
+            labels_g, cents_g = _merge_from_ident(
+                labels_g, cents_g, nl, il, identity_threshold
+            )
+            per_group[g] = (labels_g, cents_g)
+
+    for g in range(n_groups):
+        labels_g, cents_g = per_group[g]
+        results[g] = _finish(labels_g, cents_g, g_inv[g], len(umi_groups[g]))
+    return results
 
 
 _PAIR_CHUNK = 8192  # fixed device-dispatch shape for the exact-distance pass
@@ -249,6 +382,14 @@ def _merge_close_centroids(labels, centroids, codes, lens, threshold,
             ccodes, clens, shortlist_k=shortlist_k, kmer_k=kmer_k,
             pair_batch=pair_batch, mesh=mesh,
         )
+    return _merge_from_ident(labels, centroids, neigh, ident, threshold)
+
+
+def _merge_from_ident(labels, centroids, neigh, ident, threshold):
+    """Union-merge centroids whose precomputed identities cross the
+    threshold (the host half of :func:`_merge_close_centroids`; ``neigh``
+    rows index into the centroid list)."""
+    C = len(centroids)
     parent = np.arange(C)
 
     def find(x: int) -> int:

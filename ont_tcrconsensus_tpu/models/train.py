@@ -246,13 +246,13 @@ def evaluate_consensus_gain(
                 broke += v_ok and (not r_ok)
             done += cb
         out[depth] = {
-            "n": n_clusters,
-            "vote_exact": vote_ok / n_clusters,
-            "rnn_exact": rnn_ok / n_clusters,
-            "changed_frac": changed / n_clusters,
-            "edits_per_cluster": edits / n_clusters,
-            "fixed": fixed,
-            "broke": broke,
+            "n": int(n_clusters),
+            "vote_exact": float(vote_ok / n_clusters),
+            "rnn_exact": float(rnn_ok / n_clusters),
+            "changed_frac": float(changed / n_clusters),
+            "edits_per_cluster": float(edits / n_clusters),
+            "fixed": int(fixed),
+            "broke": int(broke),
         }
     return out
 

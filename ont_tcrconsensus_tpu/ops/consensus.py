@@ -204,6 +204,56 @@ def _sharded_vote_fn(mesh):
     ))
 
 
+@functools.lru_cache(maxsize=None)
+def _fused_round_fn(band_width: int, out_len: int, mesh):
+    """ONE device dispatch per consensus round: banded forward + scan-log
+    traceback + column vote fused into a single jitted program.
+
+    The unfused path pays 3 dispatches + a host sync per round per chunk —
+    hundreds of round trips per library over a tunneled TPU. Fusing also
+    lets XLA keep the direction planes on device between forward and
+    traceback. Returns (new_drafts (C, 2W), new_lens, spans (C,S,4),
+    base_at, ins_cnt, ins_base) — the pileup columns stay on device for
+    the polisher's reuse path.
+    """
+    from ont_tcrconsensus_tpu.ops.pileup import _forward_batch, _traceback_batch
+
+    def round_impl(subreads, subread_lens, drafts, dlens):
+        C, S, L = subreads.shape
+        lanes = C * S
+        reads = subreads.reshape(lanes, L)
+        rlens = subread_lens.reshape(lanes).astype(jnp.int32)
+        refs = jnp.repeat(drafts, S, axis=0)
+        reflens = jnp.repeat(dlens.astype(jnp.int32), S)
+        best, planes = _forward_batch(
+            reads, rlens, refs, reflens, band_width=band_width
+        )
+        base_at, ins_cnt, ins_base, spans = _traceback_batch(
+            best, planes, reads, band_width, out_len
+        )
+        base_at = base_at.reshape(C, S, out_len)
+        ins_cnt = ins_cnt.reshape(C, S, out_len)
+        ins_base = ins_base.reshape(C, S, out_len)
+        new_drafts, new_lens = jax.vmap(vote_columns)(
+            base_at, ins_cnt, ins_base, drafts, dlens
+        )
+        return new_drafts, new_lens, spans.reshape(C, S, 4), base_at, ins_cnt, ins_base
+
+    if mesh is None:
+        return jax.jit(round_impl)
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    d = P("data")
+    d2, d3 = P("data", None), P("data", None, None)
+    return jax.jit(shard_map(
+        round_impl, mesh=mesh,
+        in_specs=(d3, d2, d2, d),
+        out_specs=(d2, d, d3, d3, d3, d3),
+        check_vma=False,
+    ))
+
+
 def _extend_ends_batch(drafts, dlens, subreads, subread_lens, spans,
                        aligned_dlens):
     """Vectorized :func:`_extend_ends` across the cluster axis.
@@ -297,15 +347,29 @@ def consensus_clusters_batch(
 
     converged = False
     base_at = ins_cnt = ins_base = None
+    # Fused round (forward+traceback+vote in ONE dispatch) on accelerator
+    # or mesh runs; plain CPU keeps the unfused while_loop pileup (small
+    # test shapes, no dispatch latency to save).
+    use_fused = mesh is not None or jax.default_backend() != "cpu"
     vote_fn = _vote_columns_batch if mesh is None else _sharded_vote_fn(mesh)
+    d_sub = d_lens = None
+    if use_fused:
+        round_fn = _fused_round_fn(band_width, W, mesh)
+        d_sub = jnp.asarray(subreads)
+        d_lens = jnp.asarray(subread_lens).astype(jnp.int32)
     for _ in range(rounds):
-        base_at, ins_cnt, ins_base, spans = pileup.pileup_columns_batch_auto(
-            subreads, subread_lens, jnp.asarray(drafts), jnp.asarray(dlens),
-            band_width=band_width, out_len=W, mesh=mesh,
-        )
-        new_drafts, new_lens = vote_fn(
-            base_at, ins_cnt, ins_base, jnp.asarray(drafts), jnp.asarray(dlens)
-        )
+        if use_fused:
+            new_drafts, new_lens, spans, base_at, ins_cnt, ins_base = round_fn(
+                d_sub, d_lens, jnp.asarray(drafts), jnp.asarray(dlens)
+            )
+        else:
+            base_at, ins_cnt, ins_base, spans = pileup.pileup_columns_batch_auto(
+                subreads, subread_lens, jnp.asarray(drafts), jnp.asarray(dlens),
+                band_width=band_width, out_len=W, mesh=mesh,
+            )
+            new_drafts, new_lens = vote_fn(
+                base_at, ins_cnt, ins_base, jnp.asarray(drafts), jnp.asarray(dlens)
+            )
         # one coalesced device->host transfer (per-array readback pays a
         # flat round-trip each; decisive over a tunneled TPU)
         new_drafts, new_lens, spans = jax.device_get((new_drafts, new_lens, spans))

@@ -32,9 +32,12 @@ def make_mesh(shape: dict[str, int] | None = None, devices=None) -> Mesh:
     """Build a mesh; default puts every device on the data axis.
 
     ``shape`` e.g. {"data": 4, "model": 2}; axis sizes must multiply to the
-    device count used.
+    device count used. Defaults to LOCAL devices: the pipeline's meshes are
+    intra-host (chips of one TPU VM), while the cross-host axis is the
+    library shard over gloo/DCN (parallel/distributed.py) — a global-device
+    mesh here would hand every process the same (process-0) chips.
     """
-    devices = list(devices if devices is not None else jax.devices())
+    devices = list(devices if devices is not None else jax.local_devices())
     if not shape:
         shape = {"data": len(devices)}
     names = tuple(shape)

@@ -448,8 +448,10 @@ class AssignEngine:
         self._sharded_cache[has_quals] = fn
         return fn
 
-    def run_batch(self, batch: bucketing.ReadBatch, max_ee_rate: float,
-                  min_len: int) -> dict[str, np.ndarray]:
+    def run_batch_async(self, batch: bucketing.ReadBatch, max_ee_rate: float,
+                        min_len: int) -> dict[str, jax.Array]:
+        """Dispatch the fused pass; returns DEVICE arrays (jax async
+        dispatch means this does not block on the computation)."""
         has_quals = batch.quals is not None
         args = (
             jnp.asarray(batch.codes),
@@ -461,13 +463,15 @@ class AssignEngine:
             jnp.float32(max_ee_rate), jnp.int32(min_len),
         )
         if self.mesh is not None:
-            out = self._sharded_fn(has_quals)(*args)
-        else:
-            out = _fused_pass(*args, **self._static_kwargs(has_quals))
+            return self._sharded_fn(has_quals)(*args)
+        return _fused_pass(*args, **self._static_kwargs(has_quals))
+
+    def run_batch(self, batch: bucketing.ReadBatch, max_ee_rate: float,
+                  min_len: int) -> dict[str, np.ndarray]:
         # ONE batched device->host transfer: per-array readback pays a flat
         # per-transfer latency (dramatic over a tunneled TPU: ~20 arrays of
         # round-trips per batch), device_get coalesces them
-        return jax.device_get(out)
+        return jax.device_get(self.run_batch_async(batch, max_ee_rate, min_len))
 
 
 _PREFETCH_DONE = object()
@@ -576,11 +580,8 @@ def run_assign(
     acc_names: dict[int, list[list[str]]] = defaultdict(list)
 
     widths = tuple(w for w in bucketing.DEFAULT_WIDTHS if w <= max_read_length)
-    for batch in _prefetch(
-        _batches_from_source(source, batch_size, widths, subsample),
-        depth=prefetch_depth,
-    ):
-        out = engine.run_batch(batch, max_ee_rate, min_len)
+
+    def consume(batch, out):
         valid = batch.valid
         nv = int(valid.sum())
         stats.n_total += nv
@@ -648,7 +649,7 @@ def run_assign(
 
         rows = np.where(ok)[0]
         if len(rows) == 0:
-            continue
+            return
         # trimmed survivor codes, rebuilt host-side from the unshifted batch
         # (the device pass trims virtually; see _fused_pass)
         Wb = batch.codes.shape[1]
@@ -672,6 +673,22 @@ def run_assign(
         acc_names[batch.width].append(
             [batch.ids[i].partition(" ")[0] for i in rows]
         )
+
+    # Double-buffered drive: dispatch batch i, then do batch i-1's host-side
+    # consume while the device chews on i (jax async dispatch). Together
+    # with the prefetch thread this overlaps [parse/pad] | [device] | [stats
+    # + survivor compaction] across three batches in flight.
+    pending: tuple | None = None
+    for batch in _prefetch(
+        _batches_from_source(source, batch_size, widths, subsample),
+        depth=prefetch_depth,
+    ):
+        out_dev = engine.run_batch_async(batch, max_ee_rate, min_len)
+        if pending is not None:
+            consume(pending[0], jax.device_get(pending[1]))
+        pending = (batch, out_dev)
+    if pending is not None:
+        consume(pending[0], jax.device_get(pending[1]))
 
     blocks = []
     for width in sorted(acc):

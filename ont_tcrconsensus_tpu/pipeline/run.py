@@ -339,23 +339,77 @@ def _run_library(fastq, lay, cfg, panel, engine, engine_notrim,
         ),
     )
 
-    # round 1: UMI cluster + subread selection per region cluster, then ONE
-    # library-wide batched consensus polish over every group's clusters
+    # round 1: UMI records per region cluster, ONE library-wide batched
+    # clustering pass over every group (stages.cluster_and_select_grouped —
+    # per-group results, a handful of device dispatches instead of one per
+    # group), then ONE library-wide batched consensus polish
     # (stages.polish_clusters_all). A poisoned group degrades gracefully: it
     # is skipped AND reported, the rest of the library completes (the
     # reference behaves the same way for failed medaka batches,
-    # tcr_consensus.py:329-346).
+    # tcr_consensus.py:329-346) — if the BATCHED clustering pass itself
+    # fails, every group retries individually so one bad group cannot
+    # poison its peers.
     selected_by_group: list[tuple[str, list[stages.SelectedCluster]]] = []
     failed_groups: list[tuple[str, str]] = []
+    records_by_group: list[tuple[str, list]] = []
     for cluster_key in sorted(groups):
         group_name = f"region_cluster{cluster_key}"
         try:
-            sel = _round1_select(
-                group_name, groups[cluster_key], store, lay, cfg, timer,
+            with timer.stage("round1_umi_records"):
+                umis = stages.build_umi_records(
+                    store, groups[cluster_key], cfg.max_pattern_dist
+                )
+            if not umis:
+                continue
+            if cfg.write_intermediate_fastas:
+                stages.write_umi_fasta(
+                    umis, store,
+                    os.path.join(lay.umi_fasta, f"{group_name}_detected_umis.fasta"),
+                )
+            records_by_group.append((group_name, umis))
+        except Exception as exc:
+            failed_groups.append((group_name, repr(exc)))
+            _log(f"WARNING: {group_name} failed and is skipped: {exc!r}")
+
+    grouped = None
+    with timer.stage("round1_umi_cluster"):
+        try:
+            grouped = stages.cluster_and_select_grouped(
+                records_by_group,
+                identity=cfg.vsearch_identity,
+                min_umi_length=cfg.min_umi_length,
+                max_umi_length=cfg.max_umi_length,
+                min_reads_per_cluster=cfg.min_reads_per_cluster,
+                max_reads_per_cluster=cfg.max_reads_per_cluster,
+                balance_strands=cfg.balance_strands,
                 mesh=engine.mesh,
             )
-            if sel:
-                selected_by_group.append((group_name, sel))
+        except Exception as exc:
+            _log(f"WARNING: batched UMI clustering failed ({exc!r}); "
+                 "retrying each region cluster individually")
+    for group_name, umis in records_by_group:
+        try:
+            if grouped is not None:
+                selected, stat_rows = grouped[group_name]
+            else:
+                with timer.stage("round1_umi_cluster"):
+                    selected, stat_rows = stages.cluster_and_select(
+                        umis,
+                        identity=cfg.vsearch_identity,
+                        min_umi_length=cfg.min_umi_length,
+                        max_umi_length=cfg.max_umi_length,
+                        min_reads_per_cluster=cfg.min_reads_per_cluster,
+                        max_reads_per_cluster=cfg.max_reads_per_cluster,
+                        balance_strands=cfg.balance_strands,
+                        mesh=engine.mesh,
+                    )
+            cdir = os.path.join(lay.clustering, group_name)
+            os.makedirs(cdir, exist_ok=True)
+            stages.write_cluster_stats_tsv(
+                stat_rows, os.path.join(cdir, "vsearch_cluster_stats.tsv")
+            )
+            if selected:
+                selected_by_group.append((group_name, selected))
         except Exception as exc:
             failed_groups.append((group_name, repr(exc)))
             _log(f"WARNING: {group_name} failed and is skipped: {exc!r}")
@@ -397,38 +451,6 @@ def _run_library(fastq, lay, cfg, panel, engine, engine_notrim,
                        overlap_consensus, merged_consensus, timer,
                        read_batch, budget,
                        round1_complete=not failed_groups)
-
-
-def _round1_select(group_name, parts, store, lay, cfg,
-                   timer, mesh=None) -> list[stages.SelectedCluster]:
-    """UMI cluster -> subread select for one region cluster (polish is
-    batched library-wide afterwards, stages.polish_clusters_all)."""
-    with timer.stage("round1_umi_records"):
-        umis = stages.build_umi_records(store, parts, cfg.max_pattern_dist)
-    if not umis:
-        return []
-    if cfg.write_intermediate_fastas:
-        stages.write_umi_fasta(
-            umis, store,
-            os.path.join(lay.umi_fasta, f"{group_name}_detected_umis.fasta"),
-        )
-    with timer.stage("round1_umi_cluster"):
-        selected, stat_rows = stages.cluster_and_select(
-            umis,
-            identity=cfg.vsearch_identity,
-            min_umi_length=cfg.min_umi_length,
-            max_umi_length=cfg.max_umi_length,
-            min_reads_per_cluster=cfg.min_reads_per_cluster,
-            max_reads_per_cluster=cfg.max_reads_per_cluster,
-            balance_strands=cfg.balance_strands,
-            mesh=mesh,
-        )
-    cdir = os.path.join(lay.clustering, group_name)
-    os.makedirs(cdir, exist_ok=True)
-    stages.write_cluster_stats_tsv(
-        stat_rows, os.path.join(cdir, "vsearch_cluster_stats.tsv")
-    )
-    return selected
 
 
 def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
@@ -479,16 +501,70 @@ def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
     if cfg.write_intermediate_fastas:
         stages.write_region_fastas(region_groups, cons_store, lay.region_fasta, "region_")
 
-    # round 2: UMI dedup clustering at consensus identity. Per-region
-    # failures degrade gracefully like round 1: skip, report, continue.
+    # round 2: UMI dedup clustering at consensus identity — per-region
+    # records, then ONE batched clustering pass over every region (hundreds
+    # of tiny per-region calls collapse into a handful of dispatches).
+    # Per-region failures degrade gracefully like round 1: skip, report,
+    # continue; a failed batched pass retries per region.
     region_counts: dict[str, int] = {}
     region_cluster_umis: dict[str, list[str]] = {}
     failed_regions: list[tuple[str, str]] = []
+    region_records: list[tuple[str, list]] = []
     for region, parts in sorted(region_groups.items()):
         try:
-            _round2_region(region, parts, cons_store, lay, cfg, timer,
-                           region_counts, region_cluster_umis,
-                           mesh=engine_notrim.mesh)
+            with timer.stage("round2_umi_records"):
+                umis = stages.build_umi_records(
+                    cons_store, parts, cfg.max_pattern_dist
+                )
+            if not umis:
+                continue
+            if cfg.write_intermediate_fastas:
+                stages.write_umi_fasta(
+                    umis, cons_store,
+                    os.path.join(
+                        lay.consensus_umi_fasta,
+                        f"region_{region}_detected_umis.fasta",
+                    ),
+                )
+            region_records.append((region, umis))
+        except Exception as exc:
+            failed_regions.append((region, repr(exc)))
+            _log(f"WARNING: round-2 region {region} failed and is skipped: {exc!r}")
+
+    grouped2 = None
+    with timer.stage("round2_umi_cluster"):
+        try:
+            grouped2 = stages.cluster_and_select_grouped(
+                region_records,
+                identity=cfg.vsearch_identity_consensus,
+                min_umi_length=cfg.min_umi_length,
+                max_umi_length=cfg.max_umi_length,
+                min_reads_per_cluster=1,
+                max_reads_per_cluster=cfg.max_reads_per_cluster,
+                balance_strands=False,
+                mesh=engine_notrim.mesh,
+            )
+        except Exception as exc:
+            _log(f"WARNING: batched round-2 UMI clustering failed ({exc!r}); "
+                 "retrying each region individually")
+    for region, umis in region_records:
+        try:
+            if grouped2 is not None:
+                selected, stat_rows = grouped2[region]
+            else:
+                with timer.stage("round2_umi_cluster"):
+                    selected, stat_rows = stages.cluster_and_select(
+                        umis,
+                        identity=cfg.vsearch_identity_consensus,
+                        min_umi_length=cfg.min_umi_length,
+                        max_umi_length=cfg.max_umi_length,
+                        min_reads_per_cluster=1,
+                        max_reads_per_cluster=cfg.max_reads_per_cluster,
+                        balance_strands=False,
+                        mesh=engine_notrim.mesh,
+                    )
+            _finish_round2_region(region, selected, stat_rows, cons_store,
+                                  lay, cfg, region_counts, region_cluster_umis)
         except Exception as exc:
             failed_regions.append((region, repr(exc)))
             _log(f"WARNING: round-2 region {region} failed and is skipped: {exc!r}")
@@ -518,31 +594,9 @@ def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
     return region_counts
 
 
-def _round2_region(region, parts, cons_store, lay, cfg, timer,
-                   region_counts, region_cluster_umis, mesh=None) -> None:
-    """Round-2 dedup clustering + counting for one exact region."""
-    with timer.stage("round2_umi_records"):
-        umis = stages.build_umi_records(cons_store, parts, cfg.max_pattern_dist)
-    if not umis:
-        return
-    if cfg.write_intermediate_fastas:
-        stages.write_umi_fasta(
-            umis, cons_store,
-            os.path.join(
-                lay.consensus_umi_fasta, f"region_{region}_detected_umis.fasta"
-            ),
-        )
-    with timer.stage("round2_umi_cluster"):
-        selected, stat_rows = stages.cluster_and_select(
-            umis,
-            identity=cfg.vsearch_identity_consensus,
-            min_umi_length=cfg.min_umi_length,
-            max_umi_length=cfg.max_umi_length,
-            min_reads_per_cluster=1,
-            max_reads_per_cluster=cfg.max_reads_per_cluster,
-            balance_strands=False,
-            mesh=mesh,
-        )
+def _finish_round2_region(region, selected, stat_rows, cons_store, lay, cfg,
+                          region_counts, region_cluster_umis) -> None:
+    """Round-2 artifacts + counting for one exact region."""
     rdir = os.path.join(lay.clustering_consensus, f"region_{region}")
     os.makedirs(rdir, exist_ok=True)
     stages.write_cluster_stats_tsv(

@@ -191,6 +191,64 @@ def cluster_and_select(
     clusters = umi_mod.cluster_umis(
         [r.combined for r in eligible], identity, mesh=mesh
     )
+    return _select_from_clusters(
+        eligible, clusters,
+        min_reads_per_cluster=min_reads_per_cluster,
+        max_reads_per_cluster=max_reads_per_cluster,
+        balance_strands=balance_strands,
+    )
+
+
+def cluster_and_select_grouped(
+    named_records: list[tuple[str, list[UmiRecord]]],
+    identity: float,
+    min_umi_length: int,
+    max_umi_length: int,
+    min_reads_per_cluster: int,
+    max_reads_per_cluster: int,
+    balance_strands: bool,
+    mesh=None,
+) -> dict[str, tuple[list[SelectedCluster], list[dict]]]:
+    """:func:`cluster_and_select` over MANY groups with batched dispatches.
+
+    The reference runs vsearch once per region cluster / region
+    (vsearch_umi_cluster.py called per group); clustering here instead
+    batches every group through ONE global device pass
+    (:func:`..cluster.umi.cluster_umis_grouped` — cross-group identities
+    masked, so results are per-group exact) and runs the subread selection
+    host-side per group. Returns {group_name: (selected, stat_rows)}.
+    """
+    eligibles = [
+        (name, [
+            r for r in records
+            if min_umi_length <= len(r.combined) <= max_umi_length
+        ])
+        for name, records in named_records
+    ]
+    groups = [[r.combined for r in recs] for _, recs in eligibles]
+    clusters_list = umi_mod.cluster_umis_grouped(groups, identity, mesh=mesh)
+    out: dict[str, tuple[list[SelectedCluster], list[dict]]] = {}
+    for (name, recs), clusters in zip(eligibles, clusters_list):
+        if not recs:
+            out[name] = ([], [])
+            continue
+        out[name] = _select_from_clusters(
+            recs, clusters,
+            min_reads_per_cluster=min_reads_per_cluster,
+            max_reads_per_cluster=max_reads_per_cluster,
+            balance_strands=balance_strands,
+        )
+    return out
+
+
+def _select_from_clusters(
+    eligible: list[UmiRecord],
+    clusters,
+    min_reads_per_cluster: int,
+    max_reads_per_cluster: int,
+    balance_strands: bool,
+) -> tuple[list[SelectedCluster], list[dict]]:
+    """Subread selection + stats rows for one group's cluster labels."""
     members: dict[int, list[UmiRecord]] = defaultdict(list)
     for rec, lab in zip(eligible, clusters.labels):
         members[int(lab)].append(rec)

@@ -133,6 +133,50 @@ def test_shortlist_miss_is_repaired():
     assert tiny.num_clusters == 4
 
 
+def test_grouped_clustering_matches_per_group():
+    """cluster_umis_grouped == per-group cluster_umis on labels/centroids,
+    across the full-matrix and shortlist regimes and empty/single groups."""
+    rng = np.random.default_rng(9)
+    groups = []
+    # group 0: classic small molecule set (full-matrix regime alone, but the
+    # CONCATENATED unique count crosses into the shortlist regime)
+    for n_mols, reps in ((8, 6), (40, 8), (1, 1)):
+        base_umis = [
+            simulator.instantiate_iupac(rng, "TTTVVTTVVVVTTVVVVTTVVVVTTVVVVTTT")
+            + simulator.instantiate_iupac(rng, "AAABBBBAABBBBAABBBBAABBBBAABBAAA")
+            for _ in range(n_mols)
+        ]
+        obs = []
+        for u in base_umis:
+            for _ in range(reps):
+                obs.append(_mutate_umi(rng, u, int(rng.integers(0, 3))))
+        groups.append(obs)
+    groups.append([])  # empty group
+
+    grouped = umi.cluster_umis_grouped(groups, identity_threshold=0.93)
+    assert len(grouped) == len(groups)
+    for g, obs in enumerate(groups):
+        solo = umi.cluster_umis(obs, identity_threshold=0.93)
+        np.testing.assert_array_equal(
+            grouped[g].labels, solo.labels,
+            err_msg=f"group {g} labels diverge from per-group clustering",
+        )
+        assert grouped[g].num_clusters == solo.num_clusters
+        np.testing.assert_array_equal(grouped[g].centroid_of, solo.centroid_of)
+
+
+def test_grouped_clustering_never_merges_across_groups():
+    """The SAME UMI set in two groups must produce two independent
+    clusterings (cross-group identities are masked)."""
+    rng = np.random.default_rng(11)
+    base = simulator._rand_seq(rng, 60)
+    obs = [base] + [_mutate_umi(rng, base, 1) for _ in range(5)]
+    out = umi.cluster_umis_grouped([obs, list(obs)], identity_threshold=0.9)
+    for g in range(2):
+        assert out[g].num_clusters == 1
+        assert len(out[g].labels) == len(obs)
+
+
 def test_merge_close_centroids_unit():
     """Directly verify the centroid-merge repair: a centroid founded within
     the threshold of an earlier one is folded into it."""

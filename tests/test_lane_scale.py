@@ -1,0 +1,47 @@
+"""Lane-scale regression (VERDICT r2 next #5): >=100k reads end-to-end with
+a >=20k-unique-UMI region cluster, so UMI clustering runs its shortlist +
+merge-repair path (cluster/umi.py) in the regime where it actually matters.
+
+Run with ``pytest -m slow tests/test_lane_scale.py`` (takes tens of minutes
+on a CPU host; minutes on chip).
+"""
+
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_lane_scale_100k_exact_counts(tmp_path):
+    sys.path.insert(0, "scripts")
+    import lane_scale_proof
+
+    lib, heavy_region, heavy_molecules = lane_scale_proof.build_dataset(
+        str(tmp_path), target_reads=100_000
+    )
+    assert heavy_molecules >= 20_000
+    assert len(lib.reads) >= 100_000
+
+    from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+    from ont_tcrconsensus_tpu.pipeline.run import run_with_config
+
+    cfg = RunConfig.from_dict({
+        "reference_file": str(tmp_path / "reference.fa"),
+        "fastq_pass_dir": str(tmp_path / "fastq_pass"),
+        "minimal_length": 1000,
+        "min_reads_per_cluster": 2,
+        "delete_tmp_files": False,
+        "write_intermediate_fastas": False,
+        "error_profile_sample": 0,
+    })
+    results = run_with_config(cfg)
+    got = results["barcode01"]
+    want = lib.true_counts
+    # the heavy region is the point: 20k+ molecules through the shortlist path
+    assert got.get(heavy_region) == want[heavy_region], (
+        got.get(heavy_region), want[heavy_region]
+    )
+    assert got == want, {
+        k: (got.get(k, 0), want.get(k, 0))
+        for k in set(got) | set(want) if got.get(k, 0) != want.get(k, 0)
+    }

@@ -25,18 +25,23 @@ def test_examples_are_consistent():
     assert ex.mask.sum() > 100
 
 
-def test_training_reduces_loss():
-    params, losses = train.train(
-        steps=60, batch_size=8, pool_examples=24, template_len=128, log_every=0
+@pytest.fixture(scope="module")
+def trained():
+    """ONE shared 120-step training run (suite-runtime budget: training
+    twice dominated this module's cost, VERDICT r2 weak #5)."""
+    return train.train(
+        steps=120, batch_size=8, pool_examples=24, template_len=128, log_every=0
     )
+
+
+def test_training_reduces_loss(trained):
+    _, losses = trained
     assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
 
 
-def test_polish_draft_identity_when_confident():
+def test_polish_draft_identity_when_confident(trained):
     # hand-build features where the pileup unanimously supports the draft
-    params, _ = train.train(
-        steps=120, batch_size=8, pool_examples=24, template_len=128, log_every=0
-    )
+    params, _ = trained
     ex = train.make_examples(seed=7, n_examples=8, template_len=128, width=256)
     logits = np.asarray(polisher.apply_logits(params, ex.feats))
     pred = logits[..., : polisher.NUM_CLASSES].argmax(-1)
