@@ -221,6 +221,26 @@ def main():
         }
         print(f"bench: count diffs (got, want): {diff}", file=sys.stderr)
     print(f"bench: stage timing {timing}", file=sys.stderr)
+    breakdown_path = os.environ.get("BENCH_BREAKDOWN")
+    if breakdown_path:
+        import jax
+
+        total = sum(timing.values()) or 1.0
+        with open(breakdown_path, "w") as fh:
+            fh.write("# Bench stage breakdown\n\n")
+            fh.write(
+                f"{n_reads} reads, backend={jax.default_backend()}, "
+                f"timed {dt:.1f}s ({reads_per_sec:.1f} reads/s), "
+                f"warm {warm_dt:.1f}s, counts_exact={counts_ok}, "
+                f"assignment_accuracy={acc:.4f}\n\n"
+            )
+            fh.write("| stage | seconds | % of staged time |\n|---|---|---|\n")
+            for stage, sec in sorted(timing.items(), key=lambda kv: -kv[1]):
+                fh.write(f"| {stage} | {sec:.1f} | {100 * sec / total:.1f} |\n")
+            fh.write(
+                f"\nUnstaged (dataset IO, artifact writes, orchestration): "
+                f"{dt - total:.1f}s of the timed run.\n"
+            )
     emit(reads_per_sec)
 
 

@@ -58,11 +58,13 @@ def make_examples(
     seed: int,
     n_examples: int,
     template_len: int = 256,
-    depth_range: tuple[int, int] = (3, 6),
+    depth_range: tuple[int, int] = (2, 8),
     err: tuple[float, float, float] = (0.03, 0.015, 0.015),
     width: int | None = None,
     band_width: int = consensus.POLISH_BAND_WIDTH,
     error_model: simulator.OntErrorModel | None = DEFAULT_ERROR_MODEL,
+    rounds: int = 4,
+    err_weight: float = 50.0,
 ) -> ExampleBatch:
     """Build supervised examples from simulated low-depth clusters.
 
@@ -72,6 +74,17 @@ def make_examples(
     each position (``ins_labels``). Positions the truth alignment does not
     cover are masked out. ``error_model=None`` falls back to the iid
     ``err`` rates (legacy mode, kept for ablations).
+
+    Two round-3 honesty fixes (the v2.0 weights never fired their gate):
+
+    - drafts come from CONVERGED vote consensus (``rounds=4``, what the
+      pipeline serves the polisher), not round-1 drafts — the residual
+      errors after convergence are the distribution the model must fix;
+    - ``mask`` carries LOSS WEIGHTS, not just 0/1: positions where the
+      draft disagrees with the truth (or misses an insertion) are ~1% of
+      the mass, so an unweighted model learns to copy the draft with high
+      confidence and the serving gate never fires. ``err_weight`` rebalances
+      exactly those positions.
     """
     if width is None:
         width = _auto_width(template_len)
@@ -90,7 +103,7 @@ def make_examples(
             codes[i, : len(r)] = r
             lens[i] = len(r)
         draft, draft_len = consensus.consensus_cluster(
-            codes, lens, rounds=1, band_width=band_width, pad_to=width
+            codes, lens, rounds=rounds, band_width=band_width, pad_to=width
         )
         if draft_len == 0:
             continue
@@ -119,7 +132,13 @@ def make_examples(
             (t_base != pileup.UNCOVERED) & (t_ins_cnt > 0),
             t_ins_base.astype(np.int32) + 1, 0,
         ).astype(np.int32)
-        mask = ((t_base != pileup.UNCOVERED) & (np.arange(width) < draft_len)).astype(np.float32)
+        supervised = (t_base != pileup.UNCOVERED) & (np.arange(width) < draft_len)
+        disagree = supervised & (
+            (labels != draft[:width].astype(np.int32)) | (ins_labels > 0)
+        )
+        mask = np.where(
+            disagree, float(err_weight), 1.0
+        ).astype(np.float32) * supervised.astype(np.float32)
         feats_l.append(feats)
         labels_l.append(labels)
         ins_l.append(ins_labels)

@@ -92,14 +92,17 @@ class RunConfig:
 
     # --- polishing ---
     # "poa" = draft consensus only; "rnn" = draft + Flax polisher pass.
-    # Default is "poa": the precision-at-depth eval (models/weights/
-    # polisher_v1_eval.json, regenerate via `python -m ...models.train`)
-    # measures ZERO exactness gain from the RNN over the vote consensus at
-    # every depth 2-10 on pipeline-realistic 1.6 kb templates — the vote
-    # already converges to the truth wherever depth permits. "rnn" remains
-    # available for error regimes where a retrained model does earn its
-    # pileup+RNN pass.
-    polish_method: str = "poa"
+    # Default is "rnn", matching the reference's medaka precision stage.
+    # Round 2's "zero gain" finding was circular (trained AND judged on iid
+    # errors, where voting is already near-optimal); under the systematic
+    # ONT error model (homopolymer indels, context-biased subs — the errors
+    # medaka exists for) the v2 two-head polisher measures large exactness
+    # gains at depth >= 4 (models/weights/polisher_v2_eval.json, n=500/depth
+    # on 1.6 kb templates: 4.8%->27% at depth 4, 42.8%->71.2% at 6,
+    # 81.8%->89.2% at 10; fixed>>broke) and is depth-gated off below 4
+    # subreads where the pileup is too thin. Regenerate the eval via
+    # `python -m ont_tcrconsensus_tpu.models.train`.
+    polish_method: str = "rnn"
 
     # --- TPU execution (new; no reference analogue) ---
     hbm_budget_gb: float | None = None  # None -> detect chip HBM (the one
