@@ -79,16 +79,21 @@ def probe_backend(deadline_sec: float = 900.0, attempt_timeout: float = 300.0) -
 def build_dataset(root: str, seed: int = 33):
     from ont_tcrconsensus_tpu.io import fastx, simulator
 
+    # BENCH_READS scales the dataset down for CPU-side diagnostics (the
+    # driver's TPU runs keep the full default); regions scale with reads so
+    # the workload stays shape-representative.
+    target = int(os.environ.get("BENCH_READS", NUM_READS_TARGET))
+    frac = max(min(target / NUM_READS_TARGET, 1.0), 0.02)
     lib = simulator.simulate_library(
         seed=seed,
-        num_regions=56,
+        num_regions=max(int(56 * frac), 6),
         molecules_per_region=(8, 14),
         reads_per_molecule=(12, 22),
         error_model=simulator.OntErrorModel(),
         with_adapters=True,
-        num_similar_pairs=6,
+        num_similar_pairs=max(int(6 * frac), 1),
         similar_divergence=0.01,
-        num_negative_controls=2,
+        num_negative_controls=max(int(2 * frac), 1),
     )
     os.makedirs(os.path.join(root, "fastq_pass", "barcode01"), exist_ok=True)
     fastx.write_fasta(os.path.join(root, "reference.fa"), lib.reference.items())

@@ -205,7 +205,7 @@ def _sharded_vote_fn(mesh):
 
 
 @functools.lru_cache(maxsize=None)
-def _fused_round_fn(band_width: int, out_len: int, mesh):
+def _fused_round_fn(band_width: int, out_len: int, S: int, mesh):
     """ONE device dispatch per consensus round: banded forward + scan-log
     traceback + column vote fused into a single jitted program.
 
@@ -215,18 +215,21 @@ def _fused_round_fn(band_width: int, out_len: int, mesh):
     traceback. Returns (new_drafts (C, 2W), new_lens, spans (C,S,4),
     base_at, ins_cnt, ins_base) — the pileup columns stay on device for
     the polisher's reuse path.
+
+    Inputs are FLAT lanes (C folded into the leading axis; ``S`` static),
+    so the compiled-program count scales with (band, width, S) — the
+    cluster-axis chunk size C never forces a recompile.
     """
     from ont_tcrconsensus_tpu.ops.pileup import _forward_batch, _traceback_batch
 
-    def round_impl(subreads, subread_lens, drafts, dlens):
-        C, S, L = subreads.shape
-        lanes = C * S
-        reads = subreads.reshape(lanes, L)
-        rlens = subread_lens.reshape(lanes).astype(jnp.int32)
+    def round_impl(reads, rlens, drafts, dlens):
+        lanes, L = reads.shape
+        C = lanes // S
         refs = jnp.repeat(drafts, S, axis=0)
         reflens = jnp.repeat(dlens.astype(jnp.int32), S)
         best, planes = _forward_batch(
-            reads, rlens, refs, reflens, band_width=band_width
+            reads, rlens.astype(jnp.int32), refs, reflens,
+            band_width=band_width,
         )
         base_at, ins_cnt, ins_base, spans = _traceback_batch(
             best, planes, reads, band_width, out_len
@@ -248,7 +251,7 @@ def _fused_round_fn(band_width: int, out_len: int, mesh):
     d2, d3 = P("data", None), P("data", None, None)
     return jax.jit(shard_map(
         round_impl, mesh=mesh,
-        in_specs=(d3, d2, d2, d),
+        in_specs=(d2, d, d2, d),
         out_specs=(d2, d, d3, d3, d3, d3),
         check_vma=False,
     ))
@@ -354,9 +357,9 @@ def consensus_clusters_batch(
     vote_fn = _vote_columns_batch if mesh is None else _sharded_vote_fn(mesh)
     d_sub = d_lens = None
     if use_fused:
-        round_fn = _fused_round_fn(band_width, W, mesh)
-        d_sub = jnp.asarray(subreads)
-        d_lens = jnp.asarray(subread_lens).astype(jnp.int32)
+        round_fn = _fused_round_fn(band_width, W, S, mesh)
+        d_sub = jnp.asarray(subreads).reshape(C * S, W)
+        d_lens = jnp.asarray(subread_lens).reshape(C * S).astype(jnp.int32)
     for _ in range(rounds):
         if use_fused:
             new_drafts, new_lens, spans, base_at, ins_cnt, ins_base = round_fn(

@@ -59,18 +59,23 @@ def _forward_banded(read, read_len, ref, ref_len, diag_offset, band_width, scori
     off = diag_offset.astype(jnp.int32)
 
     shift_up = _shift_up
-    pad = L + W
-    ref_padded = jnp.concatenate([
-        jnp.full((pad,), PAD_SENTINEL, ref.dtype), ref, jnp.full((pad,), PAD_SENTINEL, ref.dtype)
-    ])
+    # pre-shifted ref: row i's window = ref_shifted[i : i+W], slice start
+    # shared across vmapped lanes -> contiguous slice instead of a per-row
+    # batched gather (see sw_align._align_one)
+    K = L + W
+    ks = jnp.arange(K, dtype=jnp.int32) + off - c
+    in_range = (ks >= 0) & (ks < ref.shape[0])
+    ref_shifted = jnp.where(
+        in_range, ref[jnp.clip(ks, 0, ref.shape[0] - 1)],
+        jnp.asarray(PAD_SENTINEL, ref.dtype),
+    )
 
     def row_step(carry, i):
         H, E, best = carry
         jrow = i + off - c + iota
         valid = (jrow >= 0) & (jrow < ref_len) & (i < read_len)
         rbase = read[jnp.clip(i, 0, L - 1)]
-        start = jnp.clip(i + off - c + pad, 0, ref_padded.shape[0] - W)
-        tbase = jax.lax.dynamic_slice(ref_padded, (start,), (W,))
+        tbase = jax.lax.dynamic_slice(ref_shifted, (i,), (W,))
         is_match = (tbase == rbase) & (rbase < 4) & (tbase < 4)
         sub = jnp.where(is_match, match, -mismatch).astype(jnp.int32)
 

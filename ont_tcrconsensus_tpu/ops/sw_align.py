@@ -129,19 +129,26 @@ def _align_one(read, read_len, ref, ref_len, diag_offset, band_width, scoring):
 
     shift_up = _shift_up
 
-    # ref padded so each row's band window is one contiguous dynamic slice
-    pad = L + W
-    ref_padded = jnp.concatenate([
-        jnp.full((pad,), PAD_SENTINEL, ref.dtype), ref, jnp.full((pad,), PAD_SENTINEL, ref.dtype)
-    ])
+    # Pre-shift the ref ONCE so row i's band window is ref_shifted[i : i+W]:
+    # the slice start is then the scan counter — SHARED across vmapped
+    # lanes — and XLA lowers it to a contiguous slice. The previous
+    # per-lane start (i + off - c) made every row a batched gather:
+    # ~L*W gathered elements per lane per pass, the entire runtime of the
+    # CPU path at bench shapes (same trick as sw_pallas's host pre-shift).
+    K = L + W
+    ks = jnp.arange(K, dtype=jnp.int32) + off - c
+    in_range = (ks >= 0) & (ks < ref.shape[0])
+    ref_shifted = jnp.where(
+        in_range, ref[jnp.clip(ks, 0, ref.shape[0] - 1)],
+        jnp.asarray(PAD_SENTINEL, ref.dtype),
+    )
 
     def row_step(carry, i):
         H, Hch, E, Ech, best = carry
         jrow = i + off - c + iota
         valid = (jrow >= 0) & (jrow < ref_len) & (i < read_len)
         rbase = read[jnp.clip(i, 0, L - 1)]
-        start = jnp.clip(i + off - c + pad, 0, ref_padded.shape[0] - W)
-        tbase = jax.lax.dynamic_slice(ref_padded, (start,), (W,))
+        tbase = jax.lax.dynamic_slice(ref_shifted, (i,), (W,))
         is_match = (tbase == rbase) & (rbase < 4) & (tbase < 4)
         sub = jnp.where(is_match, match, -mismatch).astype(jnp.int32)
 

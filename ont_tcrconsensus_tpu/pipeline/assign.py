@@ -167,7 +167,7 @@ def _fused_pass(
     # --- sketch candidates + strand (minimap2 seeding analogue) ---
     # computed on the untrimmed read: the <=150 nt adapter/primer margin is
     # uniform noise against a ~2 kb signal and local SW soft-clips it
-    cand_idx, _, is_rev = sketch.candidates_both_strands(
+    cand_idx, cand_scores, is_rev = sketch.candidates_both_strands(
         codes, lens, ref_profiles, top_k=top_k
     )
     oriented = jnp.where(is_rev[:, None], sketch.revcomp_batch(codes, lens), codes)
@@ -187,24 +187,47 @@ def _fused_pass(
     oriented_sw = jnp.where(in_span, oriented, jnp.uint8(sw_pallas.PAD_SENTINEL))
 
     # --- banded SW vs each candidate; keep the best score ---
-    best = None
-    for c in range(top_k):
-        ridx = cand_idx[:, c]
+    def sw_pass(codes_in, lens_in, lens_t_in, t_start_in, ridx):
         rl = jnp.take(ref_lens, ridx)
-        offs = (-t_start_o - ((lens_t - rl) // 2)).astype(jnp.int32)
+        offs = (-t_start_in - ((lens_t_in - rl) // 2)).astype(jnp.int32)
         res = sw_pallas.align_banded_auto(
-            oriented_sw, lens, jnp.take(ref_codes, ridx, axis=0), rl, offs,
+            codes_in, lens_in, jnp.take(ref_codes, ridx, axis=0), rl, offs,
             band_width=band_width,
         )
-        cur = {
+        return {
             "score": res.score, "ridx": ridx,
             "ref_start": res.ref_start, "ref_end": res.ref_end,
             "read_start": res.read_start, "read_end": res.read_end,
             "n_match": res.n_match, "n_cols": res.n_cols,
         }
-        if best is None:
-            best = cur
-        else:
+
+    best = sw_pass(oriented_sw, lens, lens_t, t_start_o, cand_idx[:, 0])
+    if top_k == 2 and B >= 8:
+        # Margin-pruned second pass: the full second SW pass nearly doubled
+        # the fused pass's dominant cost, but the sketch margin is decisive
+        # for most reads — only homologous region pairs (~1% divergence)
+        # score close. Run candidate 2 ONLY for the quarter of the batch
+        # with the smallest cosine margin (static B/4 sub-batch keeps
+        # shapes compile-stable); everyone else keeps candidate 1. The
+        # bench's assignment-accuracy check guards this capacity.
+        k2 = B // 4
+        margin = cand_scores[:, 0] - cand_scores[:, 1]
+        _, amb = jax.lax.top_k(-margin, k2)
+        cur = sw_pass(
+            jnp.take(oriented_sw, amb, axis=0), jnp.take(lens, amb),
+            jnp.take(lens_t, amb), jnp.take(t_start_o, amb),
+            jnp.take(cand_idx[:, 1], amb),
+        )
+        better = cur["score"] > jnp.take(best["score"], amb)
+        best = {
+            k: best[k].at[amb].set(
+                jnp.where(better, cur[k], jnp.take(best[k], amb))
+            )
+            for k in best
+        }
+    else:
+        for c in range(1, top_k):
+            cur = sw_pass(oriented_sw, lens, lens_t, t_start_o, cand_idx[:, c])
             better = cur["score"] > best["score"]
             best = {k: jnp.where(better, cur[k], best[k]) for k in best}
 

@@ -20,8 +20,8 @@ def test_greedy_clustering_replicates_reference_semantics():
 def test_self_homology_groups_near_duplicates():
     rng = np.random.default_rng(2)
     ref = simulator.make_reference(
-        rng, num_regions=6, num_similar_pairs=2, similar_divergence=0.005,
-        num_negative_controls=1,
+        rng, num_regions=5, num_similar_pairs=2, similar_divergence=0.005,
+        num_negative_controls=1, region_len=(1300, 1600),
     )
     res = regions.self_homology_map(ref, cluster_threshold=0.93)
     # each _sim region must share a cluster with its source

@@ -43,7 +43,7 @@ def _base_config(tmp):
         "fastq_pass_dir": str(tmp / "fastq_pass"),
         "minimal_length": 1000,
         "min_reads_per_cluster": 4,
-        "read_batch_size": 128,
+        "read_batch_size": 64,
         "polish_method": "poa",
         "delete_tmp_files": False,
     })
@@ -104,7 +104,7 @@ def test_pipeline_rnn_polish_keeps_counts_exact(sim_library, tmp_path):
         "fastq_pass_dir": str(root / "fastq_pass"),
         "minimal_length": 1000,
         "min_reads_per_cluster": 4,
-        "read_batch_size": 128,
+        "read_batch_size": 64,
         "polish_method": "rnn",
         "delete_tmp_files": False,
     })
@@ -151,7 +151,7 @@ def test_pipeline_untrimmed_reads_with_primer_trim(tmp_path):
         "fastq_pass_dir": str(tmp_path / "fastq_pass"),
         "minimal_length": 1000,
         "min_reads_per_cluster": 4,
-        "read_batch_size": 128,
+        "read_batch_size": 64,
         "polish_method": "poa",
         "delete_tmp_files": False,
     })
@@ -182,6 +182,7 @@ def test_pipeline_untrimmed_reads_with_primer_trim(tmp_path):
     assert n_trimmed == len(lib.reads)
 
 
+@pytest.mark.slow
 def test_pipeline_degrades_gracefully_on_poisoned_group(sim_library, tmp_path, monkeypatch):
     """One failing region cluster must not abort the library: the rest
     completes and the failure is reported (ref tcr_consensus.py:329-346)."""
@@ -220,7 +221,7 @@ def test_pipeline_degrades_gracefully_on_poisoned_group(sim_library, tmp_path, m
         "fastq_pass_dir": str(root / "fastq_pass"),
         "minimal_length": 1000,
         "min_reads_per_cluster": 4,
-        "read_batch_size": 128,
+        "read_batch_size": 64,
         "polish_method": "poa",
         "delete_tmp_files": False,
     })
@@ -262,7 +263,7 @@ def test_pipeline_mesh_counts_identical(sim_library, tmp_path):
         "fastq_pass_dir": str(root / "fastq_pass"),
         "minimal_length": 1000,
         "min_reads_per_cluster": 4,
-        "read_batch_size": 128,
+        "read_batch_size": 64,
         "polish_method": "poa",
         "delete_tmp_files": False,
         "mesh_shape": {"data": 8},
