@@ -129,7 +129,11 @@ def batch_parsed_reads(
     def flush(w: int) -> ReadBatch:
         rows = pending[w]
         pending[w] = []
-        B = batch_size
+        # a final partial batch pads to the pow2 of its REAL count (floor 64
+        # keeps mesh divisibility and compile classes bounded): the round-2
+        # consensus pass and tail batches otherwise pay full-batch compute
+        # for a handful of rows (CPU breakdown: round2 ~= round1 cost)
+        B = min(batch_size, pow2_ceil(len(rows), 64))
         codes = np.full((B, w), encode.PAD_CODE, dtype=np.uint8)
         quals = np.full((B, w), 93, dtype=np.uint8) if has_quals else None
         blens = np.zeros((B,), dtype=np.int32)
@@ -158,8 +162,9 @@ def batch_parsed_reads(
 
 
 def _make_batch(recs: list, width: int, batch_size: int, with_quals: bool) -> ReadBatch:
-    B = batch_size
     n = len(recs)
+    # partial batches pad to the pow2 of the real count (see batch_parsed_reads)
+    B = min(batch_size, pow2_ceil(n, 64))
     codes = np.full((B, width), encode.PAD_CODE, dtype=np.uint8)
     quals = np.full((B, width), 93, dtype=np.uint8) if with_quals else None
     lengths = np.zeros((B,), dtype=np.int32)
