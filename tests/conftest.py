@@ -20,3 +20,11 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402  (import after XLA_FLAGS is set)
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: reruns skip every jit/pallas compile (the
+# suite is single-core CPU-bound; compiles are a large slice of a cold run).
+_cache_dir = os.environ.get(
+    "JAX_TEST_CACHE_DIR", os.path.join(os.path.dirname(__file__), ".jax_cache")
+)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -21,7 +21,7 @@ def test_self_homology_groups_near_duplicates():
     rng = np.random.default_rng(2)
     ref = simulator.make_reference(
         rng, num_regions=5, num_similar_pairs=2, similar_divergence=0.005,
-        num_negative_controls=1, region_len=(1300, 1600),
+        num_negative_controls=1, region_len=(700, 900),
     )
     res = regions.self_homology_map(ref, cluster_threshold=0.93)
     # each _sim region must share a cluster with its source
@@ -40,7 +40,7 @@ def test_self_homology_groups_near_duplicates():
 
 def test_self_homology_no_similar_pairs():
     rng = np.random.default_rng(3)
-    ref = simulator.make_reference(rng, num_regions=5)
+    ref = simulator.make_reference(rng, num_regions=5, region_len=(700, 900))
     res = regions.self_homology_map(ref, cluster_threshold=0.93)
     assert res.max_blast_id is None
     assert len({res.region_cluster[n] for n in ref}) == len(ref)

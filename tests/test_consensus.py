@@ -151,7 +151,7 @@ def test_pileup_pallas_forward_matches_xla():
     from ont_tcrconsensus_tpu.ops import pileup
 
     rng = np.random.default_rng(3)
-    C, S, W = 3, 4, 256
+    C, S, W = 2, 3, 256
     sub = np.full((C, S, W), encode.PAD_CODE, np.uint8)
     lens = np.zeros((C, S), np.int32)
     drafts = np.full((C, W), encode.PAD_CODE, np.uint8)
@@ -232,7 +232,7 @@ def test_pileup_pallas_full_width_draft():
     from ont_tcrconsensus_tpu.ops import pileup
 
     rng = np.random.default_rng(21)
-    C, S, W = 2, 4, 256
+    C, S, W = 1, 3, 256
     sub = np.full((C, S, W), encode.PAD_CODE, np.uint8)
     lens = np.zeros((C, S), np.int32)
     drafts = np.full((C, W), encode.PAD_CODE, np.uint8)
