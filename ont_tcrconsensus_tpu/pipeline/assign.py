@@ -174,6 +174,20 @@ def _fused_pass(
     # trimmed-span start in the oriented frame (revcomp flips the span)
     t_start_o = jnp.where(is_rev, lens - t_end, t_start)
 
+    # Band-centering bias for one-sided primer trims: when only one primer
+    # hit, the missed side keeps its adapter/primer junk inside the span, so
+    # splitting the read-vs-ref length margin evenly mis-centers the band by
+    # ~junk/2 (~35-75 nt) — real headroom at band 128 (+/-64). Anchor the
+    # trusted side instead: its margin is just flank+UMI (~56 nt), capped at
+    # 80 so the two-sided case (margin//2 < 80) is untouched. Flags follow
+    # the span into the oriented frame (revcomp swaps the ends).
+    if primer_shapes:
+        b5, b3 = hit5 & ~hit3, hit3 & ~hit5
+        anchor5 = jnp.where(is_rev, b3, b5)
+        anchor3 = jnp.where(is_rev, b5, b3)
+    else:
+        anchor5 = anchor3 = jnp.zeros((B,), bool)
+
     # Adapter/primer bases outside the virtual-trim span are masked to the
     # pad sentinel before SW: they then never match (local alignment
     # soft-clips them), so score/blast_id/ref spans cover only the trimmed
@@ -187,9 +201,13 @@ def _fused_pass(
     oriented_sw = jnp.where(in_span, oriented, jnp.uint8(sw_pallas.PAD_SENTINEL))
 
     # --- banded SW vs each candidate; keep the best score ---
-    def sw_pass(codes_in, lens_in, lens_t_in, t_start_in, ridx):
+    def sw_pass(codes_in, lens_in, lens_t_in, t_start_in, a5_in, a3_in, ridx):
         rl = jnp.take(ref_lens, ridx)
-        offs = (-t_start_in - ((lens_t_in - rl) // 2)).astype(jnp.int32)
+        margin = lens_t_in - rl
+        half = margin // 2
+        cap = jnp.minimum(half, 80)
+        m5 = jnp.where(a5_in, cap, jnp.where(a3_in, margin - cap, half))
+        offs = (-t_start_in - m5).astype(jnp.int32)
         res = sw_pallas.align_banded_auto(
             codes_in, lens_in, jnp.take(ref_codes, ridx, axis=0), rl, offs,
             band_width=band_width,
@@ -201,7 +219,8 @@ def _fused_pass(
             "n_match": res.n_match, "n_cols": res.n_cols,
         }
 
-    best = sw_pass(oriented_sw, lens, lens_t, t_start_o, cand_idx[:, 0])
+    best = sw_pass(oriented_sw, lens, lens_t, t_start_o, anchor5, anchor3,
+                   cand_idx[:, 0])
     if top_k == 2 and B >= 8:
         # Margin-pruned second pass: the full second SW pass nearly doubled
         # the fused pass's dominant cost, but the sketch margin is decisive
@@ -216,6 +235,7 @@ def _fused_pass(
         cur = sw_pass(
             jnp.take(oriented_sw, amb, axis=0), jnp.take(lens, amb),
             jnp.take(lens_t, amb), jnp.take(t_start_o, amb),
+            jnp.take(anchor5, amb), jnp.take(anchor3, amb),
             jnp.take(cand_idx[:, 1], amb),
         )
         better = cur["score"] > jnp.take(best["score"], amb)
@@ -227,7 +247,8 @@ def _fused_pass(
         }
     else:
         for c in range(1, top_k):
-            cur = sw_pass(oriented_sw, lens, lens_t, t_start_o, cand_idx[:, c])
+            cur = sw_pass(oriented_sw, lens, lens_t, t_start_o, anchor5,
+                          anchor3, cand_idx[:, c])
             better = cur["score"] > best["score"]
             best = {k: jnp.where(better, cur[k], best[k]) for k in best}
 
@@ -388,7 +409,7 @@ class AssignEngine:
         primers: list[str] | None = None,
         primer_max_dist_frac: float = 0.15,
         top_k: int = 2,
-        band_width: int = 256,
+        band_width: int = 128,
         a5: int = 81,
         a3: int = 76,
         trim_window: int = 150,

@@ -67,6 +67,12 @@ class RunConfig:
     minimal_region_overlap: float = 0.95
     max_softclip_5_end: int = 81
     max_softclip_3_end: int = 76
+    sw_band_width: int = 128
+    #   banded-SW lanes around the length-centered diagonal. Same-read drift
+    #   is a random indel walk: std ≈ sqrt(L * indel_rate) ≈ 11 nt over 2 kb
+    #   at ONT rates, so ±64 is >5 sigma; halving from 256 halves the
+    #   dominant fused-pass kernel's per-row work (bench exactness and
+    #   assignment accuracy are the guard)
 
     # --- UMI extraction (extract_umis.py:19-107) ---
     umi_fwd: str = "TTTVVTTVVVVTTVVVVTTVVVVTTVVVVTTT"
@@ -189,6 +195,12 @@ class RunConfig:
             )
         if not isinstance(self.trim_window, int) or self.trim_window <= 0:
             raise ValueError(f"trim_window={self.trim_window!r} must be a positive int")
+        if (not isinstance(self.sw_band_width, int) or self.sw_band_width <= 0
+                or self.sw_band_width % 128):
+            raise ValueError(
+                f"sw_band_width={self.sw_band_width!r} must be a positive "
+                "multiple of 128 (TPU lane tiles)"
+            )
         if self.trim_primers and self.nanopore_tcr_seq_primers_fasta:
             if not os.path.exists(self.nanopore_tcr_seq_primers_fasta):
                 raise ValueError(

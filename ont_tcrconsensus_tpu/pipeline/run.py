@@ -102,7 +102,8 @@ def resolve_batching(cfg: RunConfig, num_refs: int, mesh=None):
         else budget_mod.detect_hbm_gb()
     )
     read_batch = cfg.read_batch_size or budget.read_batch(
-        cfg.max_read_length, num_refs=max(num_refs, 1)
+        cfg.max_read_length, num_refs=max(num_refs, 1),
+        band_width=cfg.sw_band_width,
     )
     if mesh is not None:
         n_data = dict(zip(mesh.axis_names, mesh.devices.shape))["data"]
@@ -195,12 +196,13 @@ def run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]:
         primers=cfg.primer_sequences(),
         primer_max_dist_frac=cfg.primer_max_dist_frac,
         a5=cfg.max_softclip_5_end, a3=cfg.max_softclip_3_end,
-        trim_window=cfg.trim_window, mesh=mesh,
+        trim_window=cfg.trim_window, band_width=cfg.sw_band_width, mesh=mesh,
     )
     # round 2 aligns already-trimmed consensus sequences: no primer search
     engine_notrim = stages.AssignEngine(
         panel, cfg.umi_fwd, cfg.umi_rev, primers=[],
-        a5=cfg.max_softclip_5_end, a3=cfg.max_softclip_3_end, mesh=mesh,
+        a5=cfg.max_softclip_5_end, a3=cfg.max_softclip_3_end,
+        band_width=cfg.sw_band_width, mesh=mesh,
     )
 
     fastq_list = sorted(glob.glob(os.path.join(cfg.fastq_pass_dir, "barcode*", "*fastq*")))

@@ -1,0 +1,61 @@
+"""Band centering under one-sided primer trims (code-review r3 finding).
+
+When only one primer is located, the missed side keeps its adapter junk
+inside the virtual-trim span and a symmetric margin split mis-centers the
+SW band by ~junk/2 — at band 128 (+/-64) that clipped the true path. The
+fused pass anchors the trusted side instead (assign._fused_pass); this
+test corrupts the 5' adapter+primer of every read so the 5' match fails,
+then requires every read to still pass filters with the correct region at
+the default band width.
+"""
+
+import numpy as np
+
+from ont_tcrconsensus_tpu.cluster import regions
+from ont_tcrconsensus_tpu.io import fastx, simulator
+from ont_tcrconsensus_tpu.pipeline import assign as A
+
+UMI_FWD = "TTTVVTTVVVVTTVVVVTTVVVVTTVVVVTTT"
+UMI_REV = "AAABBBBAABBBBAABBBBAABBBBAABBAAA"
+
+
+def test_one_sided_trim_reads_stay_in_band():
+    import os
+
+    lib = simulator.simulate_library(
+        seed=51, num_regions=3, molecules_per_region=(2, 3),
+        reads_per_molecule=(3, 4), error_model=simulator.OntErrorModel(),
+        with_adapters=True, region_len=(1100, 1300),
+    )
+    res = regions.self_homology_map(lib.reference, cluster_threshold=0.93)
+    panel = A.ReferencePanel.build(dict(lib.reference), res.region_cluster)
+    primers_fa = os.path.join(
+        os.path.dirname(A.__file__), "..", "primers", "primers.fasta"
+    )
+    primers = [
+        line for line in open(primers_fa).read().split()
+        if not line.startswith(">")
+    ]
+
+    rng = np.random.default_rng(0)
+    reads = []
+    for h, s, q in lib.reads:
+        # scramble the first 60 nt: the 5' primer match fails, the read is
+        # trimmed only at its 3' end and keeps ~60 nt of junk in the span
+        # in-place substitution keeps the simulator's quality string aligned
+        s = "".join("ACGT"[rng.integers(4)] for _ in range(60)) + s[60:]
+        reads.append(fastx.FastxRecord(h.split()[0], "", s, q))
+
+    eng = A.AssignEngine(panel, UMI_FWD, UMI_REV, primers=primers)
+    store, stats = A.run_assign(
+        reads, eng, max_ee_rate=0.07, min_len=1000,
+        minimal_region_overlap=0.95, max_softclip_5_end=81,
+        max_softclip_3_end=76, batch_size=64, max_read_length=4096,
+    )
+    assert stats.n_pass == stats.n_total == len(reads)
+
+    region_of_mol = {i: m.region for i, m in enumerate(lib.molecules)}
+    for blk in store.blocks:
+        for i, nm in enumerate(blk.names):
+            mol = int(nm.split("_m", 1)[1].split("_", 1)[0])
+            assert panel.names[int(blk.region_idx[i])] == region_of_mol[mol]
