@@ -353,57 +353,129 @@ def consensus_clusters_batch(
         pos < dlens[:, None], subreads[np.arange(C), seed], PAD_CODE
     ).astype(np.uint8)
 
-    converged = False
-    base_at = ins_cnt = ins_base = None
     # Fused round (forward+traceback+vote in ONE dispatch) on accelerator
     # or mesh runs; plain CPU keeps the unfused while_loop pileup (small
     # test shapes, no dispatch latency to save).
     use_fused = mesh is not None or jax.default_backend() != "cpu"
     vote_fn = _vote_columns_batch if mesh is None else _sharded_vote_fn(mesh)
-    d_sub = d_lens = None
+    n_data = mesh_data_size(mesh) if mesh is not None else 1
+
+    # Converged-cluster compaction: the vote is deterministic, so a cluster
+    # whose round produced no change is a fixed point — later rounds can
+    # skip it exactly. Measured on ONT-rate depth-4..12 clusters, ~94%
+    # stabilize by round 2, so round 3+ runs at a fraction of C (pow2
+    # sub-batches keep compile shapes bounded, like the tail batches).
+    # Per-cluster final pileups (the polisher's reuse path) are gathered the
+    # round each cluster converges and scattered into full-size buffers at
+    # the end. Compaction needs pow2 sub-batches to divide the mesh axis,
+    # so a non-pow2 data axis keeps every alive cluster active instead.
+    from ont_tcrconsensus_tpu.io.bucketing import pow2_ceil
+
+    can_compact = mesh is None or (n_data & (n_data - 1)) == 0
+    active = np.where(nreal > 0)[0]
+    pile_parts: list[tuple[np.ndarray, tuple]] = []
+    d_sub_full = d_lens_full = None
     if use_fused:
         round_fn = _fused_round_fn(band_width, W, S, mesh)
-        d_sub = jnp.asarray(subreads).reshape(C * S, W)
-        d_lens = jnp.asarray(subread_lens).reshape(C * S).astype(jnp.int32)
+
     for _ in range(rounds):
+        if len(active) == 0:
+            break
+        Ca = max(pow2_ceil(len(active)), n_data) if can_compact else C
+        if Ca >= C:
+            # full-size round: reuse the original arrays (and the cached
+            # device upload) instead of gathering a same-size copy; the
+            # bookkeeping below still tracks only `active` members
+            full, Ca, idx = True, C, np.arange(C)
+            n_act = C
+        else:
+            full = False
+            n_act = len(active)
+            idx = np.concatenate(
+                [active, np.zeros(Ca - n_act, np.int64)]
+            ) if Ca > n_act else active
+        sub_a = subreads if full else subreads[idx]
+        lens_a = subread_lens if full else subread_lens[idx]
+        drafts_a = drafts if full else drafts[idx]
+        dlens_a = dlens if full else dlens[idx]
+        # padding slots repeat cluster 0 but are masked out of every
+        # convergence/scatter decision below via in_active
+        in_active = np.zeros(C, bool)
+        in_active[active] = True
+        in_active = in_active[idx[:n_act]]
         if use_fused:
+            if full:
+                if d_sub_full is None:  # lazy: tail chunks may never run full
+                    d_sub_full = jnp.asarray(subreads).reshape(C * S, W)
+                    d_lens_full = (
+                        jnp.asarray(subread_lens).reshape(C * S).astype(jnp.int32)
+                    )
+                d_sub, d_lens = d_sub_full, d_lens_full
+            else:
+                d_sub = jnp.asarray(sub_a).reshape(Ca * S, W)
+                d_lens = jnp.asarray(lens_a).reshape(Ca * S).astype(jnp.int32)
             new_drafts, new_lens, spans, base_at, ins_cnt, ins_base = round_fn(
-                d_sub, d_lens, jnp.asarray(drafts), jnp.asarray(dlens)
+                d_sub, d_lens, jnp.asarray(drafts_a), jnp.asarray(dlens_a)
             )
         else:
             base_at, ins_cnt, ins_base, spans = pileup.pileup_columns_batch_auto(
-                subreads, subread_lens, jnp.asarray(drafts), jnp.asarray(dlens),
+                sub_a, lens_a, jnp.asarray(drafts_a), jnp.asarray(dlens_a),
                 band_width=band_width, out_len=W, mesh=mesh,
             )
             new_drafts, new_lens = vote_fn(
-                base_at, ins_cnt, ins_base, jnp.asarray(drafts), jnp.asarray(dlens)
+                base_at, ins_cnt, ins_base,
+                jnp.asarray(drafts_a), jnp.asarray(dlens_a),
             )
         # one coalesced device->host transfer (per-array readback pays a
         # flat round-trip each; decisive over a tunneled TPU)
-        new_drafts, new_lens, spans = jax.device_get((new_drafts, new_lens, spans))
+        new_drafts, new_lens, spans = jax.device_get(
+            (new_drafts, new_lens, spans)
+        )
         new_drafts = new_drafts[:, :W].copy()
         new_lens = new_lens.astype(np.int32).copy()
-        live = dlens > 0
-        if (new_lens[live] > W).any():
+        live_a = dlens_a > 0
+        if (new_lens[live_a] > W).any():
             raise ValueError("consensus grew past the padded width")
-        # empty clusters keep their (empty) draft
-        new_drafts[~live] = drafts[~live]
-        new_lens[~live] = dlens[~live]
+        # empty/padding clusters keep their draft
+        new_drafts[~live_a] = drafts_a[~live_a]
+        new_lens[~live_a] = dlens_a[~live_a]
         new_drafts, new_lens = _extend_ends_batch(
-            new_drafts, new_lens, subreads, subread_lens, spans, dlens
+            new_drafts, new_lens, sub_a, lens_a, spans, dlens_a
         )
         # vote output + extensions keep PAD beyond new_lens by construction,
         # so whole-row equality == content equality up to the lengths
-        all_unchanged = bool(
-            (new_lens == dlens).all() and (new_drafts == drafts).all()
-        )
-        drafts, dlens = new_drafts, new_lens
-        if all_unchanged:
-            converged = True
-            break
+        stable = (
+            (new_lens == dlens_a) & (new_drafts == drafts_a).all(axis=1)
+        )[:n_act]
+        drafts[idx[:n_act]] = new_drafts[:n_act]
+        dlens[idx[:n_act]] = new_lens[:n_act]
+        newly_stable = stable & in_active
+        if keep_final_pileup and newly_stable.any():
+            local = jnp.asarray(np.where(newly_stable)[0])
+            pile_parts.append((
+                idx[:n_act][newly_stable],
+                tuple(jnp.take(p, local, axis=0)
+                      for p in (base_at, ins_cnt, ins_base)),
+            ))
+        active = idx[:n_act][in_active & ~stable]
+
+    converged = len(active) == 0
     if not keep_final_pileup:
         return drafts, dlens
-    final_pileup = (base_at, ins_cnt, ins_base) if converged else None
+    final_pileup = None
+    if converged:
+        # scatter each cluster's convergence-round pileup into full-size
+        # buffers; clusters never polished (empty) read as fully uncovered,
+        # matching what a pileup against an empty draft produces
+        buf_ba = jnp.full((C, S, W), pileup.UNCOVERED, jnp.uint8)
+        buf_ic = jnp.zeros((C, S, W), jnp.int32)
+        buf_ib = jnp.zeros((C, S, W), jnp.uint8)
+        for idxs, (pba, pic, pib) in pile_parts:
+            d_idx = jnp.asarray(idxs)
+            buf_ba = buf_ba.at[d_idx].set(pba.astype(buf_ba.dtype))
+            buf_ic = buf_ic.at[d_idx].set(pic.astype(buf_ic.dtype))
+            buf_ib = buf_ib.at[d_idx].set(pib.astype(buf_ib.dtype))
+        final_pileup = (buf_ba, buf_ic, buf_ib)
     return drafts, dlens, final_pileup
 
 

@@ -105,6 +105,32 @@ def test_sharded_consensus_matches_single_device():
     np.testing.assert_array_equal(d0, d1)
 
 
+def test_sharded_consensus_non_pow2_mesh_axis():
+    """A non-pow2 data axis disables converged-cluster compaction (pow2
+    sub-batches could not divide it) but must still produce identical
+    drafts; C=6 divides the axis so the mesh survives the entry guard."""
+    from ont_tcrconsensus_tpu.ops import consensus as consensus_mod
+
+    rng = np.random.default_rng(5)
+    C, S, W = 6, 4, 256
+    sub = np.zeros((C, S, W), np.uint8)
+    lens = np.zeros((C, S), np.int32)
+    for c in range(C):
+        template = rng.integers(0, 4, 150).astype(np.uint8)
+        for s in range(S):
+            mut = _noisy_copy(rng, template)
+            sub[c, s, : len(mut)] = mut
+            lens[c, s] = len(mut)
+    d0, l0 = consensus_mod.consensus_clusters_batch(sub, lens)
+    m = mesh_mod.make_mesh({"data": 6}, devices=jax.devices()[:6])
+    d1, l1, pile = consensus_mod.consensus_clusters_batch(
+        sub, lens, mesh=m, keep_final_pileup=True
+    )
+    np.testing.assert_array_equal(l0, l1)
+    np.testing.assert_array_equal(d0, d1)
+    assert pile is not None  # converged, with the full-C rounds
+
+
 def test_graft_entry_single_chip():
     import __graft_entry__ as ge
 
