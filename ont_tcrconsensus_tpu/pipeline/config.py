@@ -123,6 +123,11 @@ class RunConfig:
     #   shard-by-barcode across processes (parallel/distributed.py)
     resume: bool = False              # stage-level resume from manifest
     write_intermediate_fastas: bool = True  # per-stage fasta artifacts
+    profile_trace_dir: str | None = None
+    #   when set, the whole run is wrapped in a jax.profiler trace written
+    #   there (one subdir per process) — open with TensorBoard/Perfetto to
+    #   see per-kernel device time, HBM traffic and host gaps; the
+    #   device-level complement of logs/stage_timing.tsv
     error_profile_sample: int = 512  # reads/library profiled for the cs-tag
     #   error artifact (qc/error_profile.py); 0 disables. 512 resolves any
     #   motif above ~1% of reads in the top-40 dump; raise for deeper audits

@@ -112,6 +112,31 @@ def resolve_batching(cfg: RunConfig, num_refs: int, mesh=None):
 
 
 def run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]:
+    """Run the pipeline; with ``profile_trace_dir`` set, the whole run is
+    captured as a jax.profiler trace (per-kernel device time, HBM traffic,
+    host gaps — view in TensorBoard/Perfetto), the device-level complement
+    of ``logs/stage_timing.tsv``. The reference had no profiler at all; on
+    TPU this is the tool that answers "which kernel is the bottleneck"."""
+    if cfg.profile_trace_dir:
+        import jax
+
+        if cfg.distributed:
+            # start_trace initializes the XLA backend, after which
+            # jax.distributed.initialize refuses to run — bring the
+            # process group up first (the inner call is a no-op then)
+            from ont_tcrconsensus_tpu.parallel import distributed as dist
+
+            dist.initialize(required=True)
+        os.makedirs(cfg.profile_trace_dir, exist_ok=True)
+        jax.profiler.start_trace(cfg.profile_trace_dir)
+        try:
+            return _run_with_config(cfg, polisher)
+        finally:
+            jax.profiler.stop_trace()
+    return _run_with_config(cfg, polisher)
+
+
+def _run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]:
     from ont_tcrconsensus_tpu.parallel import distributed as dist
 
     enable_compilation_cache()

@@ -126,6 +126,24 @@ def test_pipeline_mesh_rnn_counts_exact(sim_library, tmp_path):
     assert results["barcode01"] == lib.true_counts
 
 
+def test_pipeline_profiler_trace_written(sim_library, tmp_path):
+    """profile_trace_dir wraps the run in a jax.profiler trace (device-level
+    observability; SURVEY §5 tracing row) without touching the results."""
+    import glob
+    import shutil
+
+    tmp, lib = sim_library
+    root = tmp_path / "prof"
+    shutil.copytree(tmp / "fastq_pass" / "barcode01", root / "fastq_pass" / "barcode01")
+    shutil.copy(tmp / "reference.fa", root / "reference.fa")
+    cfg = _base_config(root)
+    cfg.profile_trace_dir = str(tmp_path / "trace")
+    results = run_with_config(cfg)
+    assert results["barcode01"] == lib.true_counts
+    assert glob.glob(str(tmp_path / "trace" / "**" / "*.xplane.pb"),
+                     recursive=True), "no profiler trace written"
+
+
 def test_pipeline_resume_skips_completed(sim_library):
     tmp, lib = sim_library
     cfg = _base_config(tmp)
