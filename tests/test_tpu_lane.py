@@ -66,7 +66,7 @@ def test_pallas_sw_matches_scan_kernel_on_tpu():
 import numpy as np, jax
 from ont_tcrconsensus_tpu.ops import sw_align, sw_pallas
 rng = np.random.default_rng(0)
-B, L, W = 32, 512, 256
+B, L = 32, 512
 reads = rng.integers(0, 4, size=(B, L)).astype(np.uint8)
 refs = reads.copy()
 # mutate refs lightly so alignments are nontrivial
@@ -74,11 +74,12 @@ mut = rng.random(refs.shape) < 0.05
 refs = np.where(mut, (refs + 1) % 4, refs).astype(np.uint8)
 lens = rng.integers(L // 2, L + 1, size=B).astype(np.int32)
 offs = np.zeros(B, np.int32)
-res_p = sw_pallas.align_banded_pallas(reads, lens, refs, lens, offs, band_width=W)
-res_s = sw_align.align_banded(reads, lens, refs, lens, offs, band_width=W)
-for f in ("score", "read_start", "read_end", "ref_start", "ref_end", "n_match", "n_cols"):
-    a, b = np.asarray(getattr(res_p, f)), np.asarray(getattr(res_s, f))
-    assert (a == b).all(), (f, a[:5], b[:5])
+for W in (128, 256):  # 128 = production default (config.sw_band_width)
+    res_p = sw_pallas.align_banded_pallas(reads, lens, refs, lens, offs, band_width=W)
+    res_s = sw_align.align_banded(reads, lens, refs, lens, offs, band_width=W)
+    for f in ("score", "read_start", "read_end", "ref_start", "ref_end", "n_match", "n_cols"):
+        a, b = np.asarray(getattr(res_p, f)), np.asarray(getattr(res_s, f))
+        assert (a == b).all(), (W, f, a[:5], b[:5])
 print("PALLAS_OK")
 """)
     assert "PALLAS_OK" in out
