@@ -527,28 +527,46 @@ def _prefetch(iterator, depth: int = 2):
     Host-side batch building (parse + encode + pad) overlaps device
     execution: the consumer blocks in device readback (GIL released) while
     the worker prepares the next padded batch (SURVEY §7 hard-part 5).
+    Abandoning the generator early (break / exception in the consumer)
+    stops the worker too: every blocking put is a timed wait on a stop
+    event the generator's ``finally`` sets, so no thread is left pinned on
+    a full queue holding padded batches.
     """
     import queue
     import threading
 
     q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def put_until_stop(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.5)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def worker():
         try:
             for item in iterator:
-                q.put(item)
-            q.put(_PREFETCH_DONE)
+                if not put_until_stop(item) or stop.is_set():
+                    return
+            put_until_stop(_PREFETCH_DONE)
         except BaseException as exc:  # propagate into the consumer
-            q.put(exc)
+            put_until_stop(exc)
 
     threading.Thread(target=worker, daemon=True).start()
-    while True:
-        item = q.get()
-        if item is _PREFETCH_DONE:
-            return
-        if isinstance(item, BaseException):
-            raise item
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _PREFETCH_DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
 
 
 def _batches_from_source(source, batch_size, widths, subsample):
@@ -718,21 +736,57 @@ def run_assign(
             [batch.ids[i].partition(" ")[0] for i in rows]
         )
 
-    # Double-buffered drive: dispatch batch i, then do batch i-1's host-side
-    # consume while the device chews on i (jax async dispatch). Together
-    # with the prefetch thread this overlaps [parse/pad] | [device] | [stats
-    # + survivor compaction] across three batches in flight.
-    pending: tuple | None = None
-    for batch in _prefetch(
-        _batches_from_source(source, batch_size, widths, subsample),
-        depth=prefetch_depth,
-    ):
-        out_dev = engine.run_batch_async(batch, max_ee_rate, min_len)
-        if pending is not None:
-            consume(pending[0], jax.device_get(pending[1]))
-        pending = (batch, out_dev)
-    if pending is not None:
-        consume(pending[0], jax.device_get(pending[1]))
+    # Pipelined drive: a prefetch thread builds padded batches, the main
+    # thread only dispatches to the device, and a consumer thread does the
+    # readback + stats + survivor compaction — [parse/pad] | [device] |
+    # [consume] run concurrently. A 2-permit semaphore acquired BEFORE each
+    # dispatch and released AFTER each consume bounds live device outputs
+    # at two batches — exactly the old double-buffer loop's HBM footprint.
+    # On a multi-core TPU VM the dispatch loop therefore never stalls on
+    # host-side compaction (VERDICT r2 #1: host work off the critical
+    # path); consume order is preserved by the single consumer thread.
+    import queue
+    import threading
+
+    inflight: queue.Queue = queue.Queue()
+    permits = threading.Semaphore(2)
+    consumer_err: list[BaseException] = []
+
+    def consumer_loop():
+        while True:
+            item = inflight.get()
+            if item is _PREFETCH_DONE:
+                return
+            batch, out_dev = item
+            try:
+                consume(batch, jax.device_get(out_dev))
+            except BaseException as exc:
+                consumer_err.append(exc)
+                return
+            finally:
+                permits.release()
+
+    consumer = threading.Thread(target=consumer_loop, daemon=True)
+    consumer.start()
+    try:
+        for batch in _prefetch(
+            _batches_from_source(source, batch_size, widths, subsample),
+            depth=prefetch_depth,
+        ):
+            # timed acquire so a dead consumer cannot deadlock the drive
+            while not permits.acquire(timeout=1.0):
+                if consumer_err or not consumer.is_alive():
+                    break
+            else:
+                out_dev = engine.run_batch_async(batch, max_ee_rate, min_len)
+                inflight.put((batch, out_dev))
+                continue
+            break
+    finally:
+        inflight.put(_PREFETCH_DONE)
+        consumer.join()
+    if consumer_err:
+        raise consumer_err[0]
 
     blocks = []
     for width in sorted(acc):
