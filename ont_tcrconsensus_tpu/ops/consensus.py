@@ -398,11 +398,15 @@ def consensus_clusters_batch(
         lens_a = subread_lens if full else subread_lens[idx]
         drafts_a = drafts if full else drafts[idx]
         dlens_a = dlens if full else dlens[idx]
-        # padding slots repeat cluster 0 but are masked out of every
-        # convergence/scatter decision below via in_active
-        in_active = np.zeros(C, bool)
-        in_active[active] = True
-        in_active = in_active[idx[:n_act]]
+        # compacted rounds carry exactly `active` in idx[:n_act]; a full
+        # round revisits every cluster, so mask the non-active ones out of
+        # the convergence/scatter bookkeeping below (padding slots repeat
+        # cluster 0 and are excluded the same way)
+        if full:
+            in_active = np.zeros(C, bool)
+            in_active[active] = True
+        else:
+            in_active = np.ones(n_act, bool)
         if use_fused:
             if full:
                 if d_sub_full is None:  # lazy: tail chunks may never run full

@@ -766,6 +766,13 @@ def run_assign(
             finally:
                 permits.release()
 
+    def acquire_permit() -> bool:
+        """Timed acquire so a dead consumer cannot deadlock the drive."""
+        while not permits.acquire(timeout=1.0):
+            if consumer_err or not consumer.is_alive():
+                return False
+        return True
+
     consumer = threading.Thread(target=consumer_loop, daemon=True)
     consumer.start()
     try:
@@ -773,15 +780,10 @@ def run_assign(
             _batches_from_source(source, batch_size, widths, subsample),
             depth=prefetch_depth,
         ):
-            # timed acquire so a dead consumer cannot deadlock the drive
-            while not permits.acquire(timeout=1.0):
-                if consumer_err or not consumer.is_alive():
-                    break
-            else:
-                out_dev = engine.run_batch_async(batch, max_ee_rate, min_len)
-                inflight.put((batch, out_dev))
-                continue
-            break
+            if not acquire_permit():
+                break
+            out_dev = engine.run_batch_async(batch, max_ee_rate, min_len)
+            inflight.put((batch, out_dev))
     finally:
         inflight.put(_PREFETCH_DONE)
         consumer.join()

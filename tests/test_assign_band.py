@@ -20,7 +20,7 @@ UMI_REV = "AAABBBBAABBBBAABBBBAABBBBAABBAAA"
 
 
 def test_one_sided_trim_reads_stay_in_band():
-    import os
+    from ont_tcrconsensus_tpu.pipeline.config import RunConfig
 
     lib = simulator.simulate_library(
         seed=51, num_regions=3, molecules_per_region=(2, 3),
@@ -29,13 +29,9 @@ def test_one_sided_trim_reads_stay_in_band():
     )
     res = regions.self_homology_map(lib.reference, cluster_threshold=0.93)
     panel = A.ReferencePanel.build(dict(lib.reference), res.region_cluster)
-    primers_fa = os.path.join(
-        os.path.dirname(A.__file__), "..", "primers", "primers.fasta"
-    )
-    primers = [
-        line for line in open(primers_fa).read().split()
-        if not line.startswith(">")
-    ]
+    primers = RunConfig.from_dict(
+        {"reference_file": "x", "fastq_pass_dir": "y"}
+    ).primer_sequences()
 
     rng = np.random.default_rng(0)
     reads = []
