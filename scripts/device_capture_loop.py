@@ -1,0 +1,199 @@
+"""Session-long opportunistic TPU capture loop.
+
+The axon TPU tunnel flaps for hours at a time (rounds 2-3 ended with zero
+device evidence because every capture attempt happened to land in an
+outage).  This loop converts ANY window of tunnel uptime into committed
+perf artifacts:
+
+  1. probes the backend every ``--interval`` seconds in a timeout-wrapped
+     subprocess (jax.devices() hangs indefinitely when the tunnel is
+     wedged, so the probe must be killable);
+  2. logs every probe to TUNNEL_LOG.md — the outage record itself is a
+     deliverable (proof the loop ran all session);
+  3. on the first success runs, in order of cost:
+       a. kernel_bench.py            -> KERNEL_BENCH.json   (<60 s warm)
+       b. bench.py BENCH_READS=2000  -> BENCH_TPU_CAPTURE.json
+       c. bench.py (full 10k reads)  -> BENCH_TPU_CAPTURE_FULL.json
+     Each step is independently resumable: partial kernel results survive
+     (kernel_bench writes after every kernel), and the persistent compile
+     cache makes a post-outage retry skip straight to execution.
+
+Run it in the background for the whole session:
+    python scripts/device_capture_loop.py &
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import probe_once  # noqa: E402  (shared single-attempt probe)
+
+LOG = os.path.join(REPO, "TUNNEL_LOG.md")
+KERNEL_OUT = os.path.join(REPO, "KERNEL_BENCH.json")
+BENCH_OUT = os.path.join(REPO, "BENCH_TPU_CAPTURE.json")
+BENCH_FULL_OUT = os.path.join(REPO, "BENCH_TPU_CAPTURE_FULL.json")
+
+
+def log_line(text: str) -> None:
+    stamp = time.strftime("%Y-%m-%d %H:%M:%SZ", time.gmtime())
+    new = not os.path.exists(LOG)
+    with open(LOG, "a") as fh:
+        if new:
+            fh.write(
+                "# TPU tunnel availability log\n\n"
+                "Written by scripts/device_capture_loop.py — one line per "
+                "backend probe / capture attempt, for the whole session.\n\n"
+            )
+        fh.write(f"- {stamp} {text}\n")
+    print(f"capture_loop: {text}", file=sys.stderr, flush=True)
+
+
+def kernel_done() -> bool:
+    try:
+        with open(KERNEL_OUT) as fh:
+            rep = json.load(fh)
+        return rep.get("platform") == "tpu" and all(
+            rep.get("kernels", {}).get(k, {}).get("value") is not None
+            for k in ("sw", "pileup", "rnn", "fused")
+        )
+    except (OSError, json.JSONDecodeError):
+        return False
+
+
+def bench_done(path: str) -> bool:
+    try:
+        with open(path) as fh:
+            line = json.load(fh)
+        return (isinstance(line, dict)
+                and float(line.get("value", 0.0)) > 0.0
+                and "stale_capture" not in line
+                and "error" not in line)
+    except (OSError, ValueError):
+        return False
+
+
+def run_capture(cmd: list[str], timeout: float, out_path: str | None,
+                env_extra: dict | None = None, label: str = "",
+                verify=None) -> bool:
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    log_line(f"CAPTURE start: {label}")
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout,
+            cwd=REPO, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        log_line(f"CAPTURE timeout after {timeout:.0f}s: {label}")
+        return False
+    dt = time.time() - t0
+    tail = (proc.stderr or "").strip().splitlines()[-3:]
+    if proc.returncode != 0:
+        log_line(
+            f"CAPTURE rc={proc.returncode} after {dt:.0f}s: {label} "
+            f"({' | '.join(tail)})"
+        )
+        return False
+    if out_path is not None and proc.stdout.strip():
+        last = proc.stdout.strip().splitlines()[-1]
+        with open(out_path, "w") as fh:
+            fh.write(last + "\n")
+    # rc==0 is not success: bench.py deliberately exits 0 with an error
+    # JSON line when its own probe fails — only the artifact check decides
+    if verify is not None and not verify():
+        log_line(
+            f"CAPTURE rc=0 but artifact invalid after {dt:.0f}s: {label} "
+            f"({' | '.join(tail[-1:])})"
+        )
+        return False
+    log_line(f"CAPTURE ok after {dt:.0f}s: {label} ({' | '.join(tail[-1:])})")
+    return True
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--interval", type=float, default=150.0,
+                    help="probe period (s) while captures are pending")
+    ap.add_argument("--idle-interval", type=float, default=600.0,
+                    help="probe period (s) once every capture is done")
+    args = ap.parse_args()
+
+    log_line("loop started "
+             f"(pid {os.getpid()}, interval {args.interval:.0f}s)")
+
+    # capture stages, cheapest first. A deterministically failing stage must
+    # not starve the others (review finding): the eligible stage with the
+    # FEWEST attempts runs next, which round-robins across failing stages
+    # while naturally preferring untried ones.
+    stages = [
+        {
+            "label": "kernel_bench", "attempts": 0,
+            "done": kernel_done,
+            "cmd": [sys.executable, "kernel_bench.py", "--out", KERNEL_OUT],
+            "timeout": 1800, "out": None, "env": None,
+        },
+        {
+            "label": "bench 2k reads", "attempts": 0,
+            "done": lambda: bench_done(BENCH_OUT),
+            "cmd": [sys.executable, "bench.py"],
+            "timeout": 3000, "out": BENCH_OUT,
+            "env": {"BENCH_READS": "2000", "BENCH_NO_FALLBACK": "1"},
+        },
+        {
+            "label": "bench 10k reads", "attempts": 0,
+            "done": lambda: bench_done(BENCH_FULL_OUT),
+            "cmd": [sys.executable, "bench.py"],
+            "timeout": 5400, "out": BENCH_FULL_OUT,
+            "env": {"BENCH_NO_FALLBACK": "1"},
+        },
+    ]
+
+    consecutive_down = 0
+    consecutive_up = 0
+    while True:
+        plat, detail = probe_once()
+        if plat != "tpu":
+            consecutive_up = 0
+            consecutive_down += 1
+            # one line per state change + a heartbeat every 10 probes, so
+            # the log stays readable over a 12 h session
+            if consecutive_down == 1 or consecutive_down % 10 == 0:
+                log_line(
+                    f"DOWN ({detail if plat is None else plat}, "
+                    f"{consecutive_down} consecutive)"
+                )
+            time.sleep(args.interval)
+            continue
+        if consecutive_down:
+            log_line(f"UP after {consecutive_down} down probes")
+        elif consecutive_up == 0 or consecutive_up % 10 == 0:
+            log_line(f"UP ({consecutive_up + 1} consecutive)")
+        consecutive_down = 0
+        consecutive_up += 1
+
+        pending = [s for s in stages if not s["done"]()]
+        if not pending:
+            time.sleep(args.idle_interval)
+            continue
+        stage = min(pending, key=lambda s: s["attempts"])
+        stage["attempts"] += 1
+        run_capture(
+            stage["cmd"], timeout=stage["timeout"], out_path=stage["out"],
+            env_extra=stage["env"],
+            label=f"{stage['label']} (attempt {stage['attempts']})",
+            verify=stage["done"],
+        )
+        time.sleep(5)  # re-probe promptly between capture steps
+
+
+if __name__ == "__main__":
+    main()
