@@ -474,7 +474,8 @@ def consensus_clusters_batch(
         buf_ba = jnp.full((C, S, W), pileup.UNCOVERED, jnp.uint8)
         buf_ic = jnp.zeros((C, S, W), jnp.int32)
         buf_ib = jnp.zeros((C, S, W), jnp.uint8)
-        for idxs, (pba, pic, pib) in pile_parts:
+        while pile_parts:  # pop-consume so each part frees after scatter
+            idxs, (pba, pic, pib) = pile_parts.pop(0)
             d_idx = jnp.asarray(idxs)
             buf_ba = buf_ba.at[d_idx].set(pba.astype(buf_ba.dtype))
             buf_ic = buf_ic.at[d_idx].set(pic.astype(buf_ic.dtype))

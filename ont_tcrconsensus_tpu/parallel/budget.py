@@ -82,7 +82,11 @@ class BudgetModel:
     def cluster_bytes(self, s_bucket: int, width: int,
                       band_width: int = 128) -> int:
         traceback = 2 * s_bucket * width * band_width  # tdir+fjump u8 planes
-        pileup = s_bucket * width * (1 + 4 + 1)        # base_at/ins_cnt/ins_base
+        # base_at/ins_cnt/ins_base, times two: keep_final_pileup (the rnn
+        # polish path, the default with bundled weights) transiently holds
+        # both the accumulated per-part pileups and the full scatter
+        # buffers at compaction-scatter time (ADVICE r3)
+        pileup = 2 * s_bucket * width * (1 + 4 + 1)
         votes = 2 * width * 4 * 8                      # vote stacks (int32)
         return traceback + pileup + votes
 

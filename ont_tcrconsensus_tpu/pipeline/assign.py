@@ -179,8 +179,10 @@ def _fused_pass(
     # splitting the read-vs-ref length margin evenly mis-centers the band by
     # ~junk/2 (~35-75 nt) — real headroom at band 128 (+/-64). Anchor the
     # trusted side instead: its margin is just flank+UMI (~56 nt), capped at
-    # 80 so the two-sided case (margin//2 < 80) is untouched. Flags follow
-    # the span into the oriented frame (revcomp swaps the ends).
+    # that side's configured softclip budget (a5/a3, ADVICE r3: a config
+    # with a longer flank+UMI region raises the cap with it) so the
+    # two-sided case (margin//2 < cap) is untouched. Flags follow the span
+    # into the oriented frame (revcomp swaps the ends).
     if primer_shapes:
         b5, b3 = hit5 & ~hit3, hit3 & ~hit5
         anchor5 = jnp.where(is_rev, b3, b5)
@@ -205,8 +207,9 @@ def _fused_pass(
         rl = jnp.take(ref_lens, ridx)
         margin = lens_t_in - rl
         half = margin // 2
-        cap = jnp.minimum(half, 80)
-        m5 = jnp.where(a5_in, cap, jnp.where(a3_in, margin - cap, half))
+        cap5 = jnp.minimum(half, a5)
+        cap3 = jnp.minimum(half, a3)
+        m5 = jnp.where(a5_in, cap5, jnp.where(a3_in, margin - cap3, half))
         offs = (-t_start_in - m5).astype(jnp.int32)
         res = sw_pallas.align_banded_auto(
             codes_in, lens_in, jnp.take(ref_codes, ridx, axis=0), rl, offs,

@@ -92,20 +92,18 @@ def test_pipeline_consensus_sequences_exact(sim_library):
     )
 
 
-def test_pipeline_mesh_rnn_counts_exact(sim_library, tmp_path):
-    """ONE 8-device data-sharded run with the confidence-gated RNN polish:
-    the mesh path (SURVEY §2.3, virtual CPU mesh) must produce counts
-    identical to ground truth AND the RNN pass must never corrupt a correct
-    consensus. Combined run = the mesh-sharded fused pass, UMI clustering,
-    consensus rounds AND polisher serving in a single pipeline execution
-    (two separate runs covered strictly less and doubled suite time).
-    Without bundled weights the run falls back to 'poa' so the mesh path
-    keeps unconditional e2e coverage."""
+@pytest.mark.parametrize("polish_method", ["poa", "rnn"])
+def test_pipeline_mesh_rnn_counts_exact(sim_library, tmp_path, polish_method):
+    """8-device data-sharded runs with BOTH polish methods: the mesh path
+    (SURVEY §2.3, virtual CPU mesh) must produce counts identical to ground
+    truth, with the confidence-gated RNN never corrupting a correct
+    consensus AND the poa variant covering keep_final_pileup=False under a
+    mesh (ADVICE r3: the folded single-method test silently dropped
+    whichever path the bundled-weights check deselected)."""
     from ont_tcrconsensus_tpu.models import polisher as polisher_mod
 
-    polish_method = (
-        "rnn" if polisher_mod.load_default_params() is not None else "poa"
-    )
+    if polish_method == "rnn" and polisher_mod.load_default_params() is None:
+        pytest.skip("no bundled polisher weights")
     tmp, lib = sim_library
     import shutil
 
