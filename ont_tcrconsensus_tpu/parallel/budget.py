@@ -80,13 +80,14 @@ class BudgetModel:
         return _pow2_floor(self.budget_bytes // per, 128, 16384)
 
     def cluster_bytes(self, s_bucket: int, width: int,
-                      band_width: int = 128) -> int:
+                      band_width: int = 128,
+                      keep_final_pileup: bool = True) -> int:
         traceback = 2 * s_bucket * width * band_width  # tdir+fjump u8 planes
-        # base_at/ins_cnt/ins_base, times two: keep_final_pileup (the rnn
-        # polish path, the default with bundled weights) transiently holds
-        # both the accumulated per-part pileups and the full scatter
-        # buffers at compaction-scatter time (ADVICE r3)
-        pileup = 2 * s_bucket * width * (1 + 4 + 1)
+        # base_at/ins_cnt/ins_base; keep_final_pileup (the rnn polish path,
+        # the default with bundled weights) transiently holds BOTH the
+        # accumulated per-part pileups and the full scatter buffers at
+        # compaction-scatter time (ADVICE r3), hence the extra copy
+        pileup = (2 if keep_final_pileup else 1) * s_bucket * width * (1 + 4 + 1)
         votes = 2 * width * 4 * 8                      # vote stacks (int32)
         return traceback + pileup + votes
 
@@ -97,7 +98,9 @@ class BudgetModel:
     MAX_POLISH_LANES = 4096
 
     def cluster_batch(self, s_bucket: int, width: int,
-                      band_width: int = 128) -> int:
-        per = self.cluster_bytes(s_bucket, width, band_width)
+                      band_width: int = 128,
+                      keep_final_pileup: bool = True) -> int:
+        per = self.cluster_bytes(s_bucket, width, band_width,
+                                 keep_final_pileup)
         hi = min(256, max(1, self.MAX_POLISH_LANES // max(s_bucket, 1)))
         return _pow2_floor(self.budget_bytes // per, 1, hi)

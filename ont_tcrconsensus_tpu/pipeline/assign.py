@@ -260,18 +260,28 @@ def _fused_pass(
     # virtual-trimmed read, gathered at the span offsets and stacked into
     # ONE multi-pattern dispatch (windows padded to a common width)
     aw = max(a5, a3)
+    # a5/a3 are MOLECULE-frame budgets (the reference measures softclips on
+    # the BAM-oriented read, region_split.py:226-227) but these windows
+    # slice the PHYSICAL read (the mutually-revcomp UMI patterns make the
+    # pattern choice strand-agnostic), so the per-side budgets swap for
+    # reverse-strand reads: a minus read's physical 5' end carries the
+    # molecule's 3' structure. Symmetric-ish defaults (81/76) hide this;
+    # an asymmetric config (long 5' flank) would otherwise clip the
+    # fwd UMI out of minus reads' 3' window.
+    bw5 = jnp.where(is_rev, a3, a5)
+    bw3 = jnp.where(is_rev, a5, a3)
     pos_w = jnp.arange(aw, dtype=jnp.int32)[None, :]
     idx5 = jnp.clip(t_start[:, None] + pos_w, 0, W - 1)
     w5 = jnp.take(jnp.asarray(encode.CODE_TO_MASK),
                   jnp.take_along_axis(codes, idx5, axis=1).astype(jnp.int32))
-    w5 = jnp.where(pos_w < a5, w5, jnp.uint8(0))
-    l5 = jnp.minimum(lens_t, a5)
-    start3 = jnp.maximum(lens_t - a3, 0)  # trimmed-frame coords (downstream)
+    w5 = jnp.where(pos_w < bw5[:, None], w5, jnp.uint8(0))
+    l5 = jnp.minimum(lens_t, bw5)
+    start3 = jnp.maximum(lens_t - bw3, 0)  # trimmed-frame coords (downstream)
     idx3 = jnp.clip((t_start + start3)[:, None] + pos_w, 0, W - 1)
     w3 = jnp.take(jnp.asarray(encode.CODE_TO_MASK),
                   jnp.take_along_axis(codes, idx3, axis=1).astype(jnp.int32))
-    w3 = jnp.where(pos_w < a3, w3, jnp.uint8(0))
-    l3 = jnp.minimum(lens_t, a3)
+    w3 = jnp.where(pos_w < bw3[:, None], w3, jnp.uint8(0))
+    l3 = jnp.minimum(lens_t, bw3)
     ud, us, ue = fuzzy_match.fuzzy_find_multi(
         umi_masks, umi_mask_lens,
         jnp.concatenate([w5, w3], axis=0),

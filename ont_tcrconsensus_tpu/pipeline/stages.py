@@ -410,7 +410,8 @@ def polish_clusters_all(
         if cluster_batch is not None:
             cb = cluster_batch
         elif budget is not None:
-            cb = budget.cluster_batch(s_bucket, width, eff_band)
+            cb = budget.cluster_batch(s_bucket, width, eff_band,
+                                      keep_final_pileup=polisher is not None)
         else:
             cb = 16
         # never pad the cluster axis past the work available (a small

@@ -55,3 +55,43 @@ def test_one_sided_trim_reads_stay_in_band():
         for i, nm in enumerate(blk.names):
             mol = int(nm.split("_m", 1)[1].split("_", 1)[0])
             assert panel.names[int(blk.region_idx[i])] == region_of_mol[mol]
+
+
+def test_asymmetric_softclip_budgets_window_minus_strand_umis():
+    """a5/a3 are MOLECULE-frame budgets; the fused pass slices PHYSICAL
+    windows, so it must swap the budgets for reverse-strand reads
+    (code-review r4 finding). A long 5' flank (a5=160 >> a3=60) would
+    otherwise clip the fwd UMI out of minus reads' physical 3' window.
+
+    Clean reads (no errors): every UMI must be found at distance 0 on
+    BOTH strands."""
+    from ont_tcrconsensus_tpu.io import bucketing
+    from ont_tcrconsensus_tpu.ops import encode as enc
+
+    rng = np.random.default_rng(7)
+    bases = np.array(list("ACGT"))
+    mk = lambda n: "".join(rng.choice(bases, size=n))
+    reference = {"R0": mk(1200), "R1": mk(1200)}
+    res = regions.self_homology_map(reference, cluster_threshold=0.93)
+    panel = A.ReferencePanel.build(reference, res.region_cluster)
+
+    left, right = mk(100), mk(10)           # asymmetric flanks
+    iupac = {"V": "ACG", "B": "CGT", "T": "T", "A": "A"}
+
+    def inst(pattern):
+        return "".join(iupac[c][rng.integers(len(iupac[c]))] for c in pattern)
+    recs = []
+    for i, region in enumerate(["R0", "R1", "R0", "R1"]):
+        u5, u3 = inst(UMI_FWD), inst(UMI_REV)
+        template = left + u5 + reference[region] + u3 + right
+        seq = template if i % 2 == 0 else enc.revcomp_str(template)
+        recs.append(fastx.FastxRecord(f"r{i}", "", seq, None))
+
+    eng = A.AssignEngine(panel, UMI_FWD, UMI_REV, primers=[], a5=160, a3=60)
+    batch = next(bucketing.batch_reads(recs, batch_size=8, with_quals=False))
+    out = eng.run_batch(batch, max_ee_rate=0.07, min_len=500)
+    valid = batch.lengths > 0
+    assert valid.sum() == 4
+    assert out["is_rev"][valid].tolist() == [False, True, False, True]
+    assert (out["d5"][valid] == 0).all(), out["d5"][valid]
+    assert (out["d3"][valid] == 0).all(), out["d3"][valid]
