@@ -88,6 +88,122 @@ class ReferencePanel:
 # the fused device pass
 
 
+def _umi_windows(codes, lens_t, t_start, is_rev, umi_masks, umi_mask_lens,
+                 *, a5: int, a3: int) -> dict:
+    """Fwd/rev UMI pattern search in both adapter windows — ONE dispatch.
+
+    a5/a3 are MOLECULE-frame budgets (the reference measures softclips on
+    the BAM-oriented read, region_split.py:226-227) but these windows
+    slice the PHYSICAL read (the mutually-revcomp UMI patterns make the
+    pattern choice strand-agnostic), so the per-side budgets swap for
+    reverse-strand reads: a minus read's physical 5' end carries the
+    molecule's 3' structure. Symmetric-ish defaults (81/76) hide this;
+    an asymmetric config (long 5' flank) would otherwise clip the
+    fwd UMI out of minus reads' 3' window.
+    """
+    B, W = codes.shape
+    aw = max(a5, a3)
+    bw5 = jnp.where(is_rev, a3, a5)
+    bw3 = jnp.where(is_rev, a5, a3)
+    pos_w = jnp.arange(aw, dtype=jnp.int32)[None, :]
+    idx5 = jnp.clip(t_start[:, None] + pos_w, 0, W - 1)
+    w5 = jnp.take(jnp.asarray(encode.CODE_TO_MASK),
+                  jnp.take_along_axis(codes, idx5, axis=1).astype(jnp.int32))
+    w5 = jnp.where(pos_w < bw5[:, None], w5, jnp.uint8(0))
+    l5 = jnp.minimum(lens_t, bw5)
+    start3 = jnp.maximum(lens_t - bw3, 0)  # trimmed-frame coords (downstream)
+    idx3 = jnp.clip((t_start + start3)[:, None] + pos_w, 0, W - 1)
+    w3 = jnp.take(jnp.asarray(encode.CODE_TO_MASK),
+                  jnp.take_along_axis(codes, idx3, axis=1).astype(jnp.int32))
+    w3 = jnp.where(pos_w < bw3[:, None], w3, jnp.uint8(0))
+    l3 = jnp.minimum(lens_t, bw3)
+    ud, us, ue = fuzzy_match.fuzzy_find_multi(
+        umi_masks, umi_mask_lens,
+        jnp.concatenate([w5, w3], axis=0),
+        jnp.concatenate([l5, l3], axis=0),
+    )  # each (2, 2B)
+    return {
+        "d5": ud[0, :B], "s5": us[0, :B], "e5": ue[0, :B],
+        "d3": ud[1, B:], "s3": us[1, B:], "e3": ue[1, B:],
+        "start3": start3,
+    }
+
+
+@functools.partial(
+    jax.jit, static_argnames=("band_width", "a5", "a3", "max_c")
+)
+def _targeted_pass(
+    codes, lens, cand_idx,
+    ref_codes, ref_lens,
+    umi_masks, umi_mask_lens,
+    min_len,
+    *,
+    band_width: int, a5: int, a3: int, max_c: int,
+):
+    """Round-2 device pass: align each consensus ONLY against its known
+    region cluster's references (VERDICT r3 #6).
+
+    Round 1 already binned every molecule into a region cluster, and the
+    consensus drafts are molecule-(+)-oriented by construction
+    (stages.py polish path orients subreads before the vote), so the full
+    sketch -> both-strand top-k -> SW re-derivation of the fused pass is
+    pure waste here: no primer trim (consensus carries only flank+UMI
+    ends that local SW soft-clips), no EE data, no strand search, and the
+    candidate set is the <=max_c refs of the read's own cluster
+    (``cand_idx`` (B, max_c) int32, -1 padded). The reference re-aligns
+    the full library (ref:tcr_consensus.py:356-372) because minimap2 has
+    no notion of provenance; blast-id filter semantics downstream are
+    IDENTICAL (same consume path).
+
+    Returns the same out-dict contract as :func:`_fused_pass`.
+    """
+    B, W = codes.shape
+    lens = lens.astype(jnp.int32)
+    t_start = jnp.zeros((B,), jnp.int32)
+    lens_t = lens
+    ee_ok = lens_t >= min_len
+    is_rev = jnp.zeros((B,), bool)
+
+    def sw_one(ridx):
+        valid_c = ridx >= 0
+        r = jnp.where(valid_c, ridx, 0)
+        rl = jnp.take(ref_lens, r)
+        # two-sided margin split: consensus flank+UMI margins are small
+        # and symmetric (no one-sided-trim case exists here)
+        m5 = (lens_t - rl) // 2
+        res = sw_pallas.align_banded_auto(
+            codes, lens_t, jnp.take(ref_codes, r, axis=0), rl,
+            (-m5).astype(jnp.int32), band_width=band_width,
+        )
+        return {
+            "ridx": r.astype(jnp.int32),
+            "score": jnp.where(valid_c, res.score, jnp.int32(-1)),
+            "n_match": res.n_match, "n_cols": res.n_cols,
+            "ref_start": res.ref_start, "ref_end": res.ref_end,
+            "read_start": res.read_start, "read_end": res.read_end,
+        }
+
+    best = sw_one(cand_idx[:, 0])
+    for c in range(1, max_c):
+        cur = sw_one(cand_idx[:, c])
+        better = cur["score"] > best["score"]  # ties keep the earlier ref
+        best = {k: jnp.where(better, cur[k], best[k]) for k in best}
+
+    umi_out = _umi_windows(
+        codes, lens_t, t_start, is_rev, umi_masks, umi_mask_lens, a5=a5, a3=a3
+    )
+    blast_id = best["n_match"] / jnp.maximum(best["n_cols"], 1)
+    return {
+        "lens": lens_t, "t_start": t_start,
+        "ee_ok": ee_ok, "is_rev": is_rev,
+        "ridx": best["ridx"], "score": best["score"],
+        "blast_id": blast_id.astype(jnp.float32),
+        "ref_start": best["ref_start"], "ref_end": best["ref_end"],
+        "read_start": best["read_start"], "read_end": best["read_end"],
+        **umi_out,
+    }
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -256,39 +372,9 @@ def _fused_pass(
             best = {k: jnp.where(better, cur[k], best[k]) for k in best}
 
     # --- UMI fuzzy location in both adapter windows (extract_umis.py:19-126)
-    # fwd pattern on the 5' window + rev pattern on the 3' window of the
-    # virtual-trimmed read, gathered at the span offsets and stacked into
-    # ONE multi-pattern dispatch (windows padded to a common width)
-    aw = max(a5, a3)
-    # a5/a3 are MOLECULE-frame budgets (the reference measures softclips on
-    # the BAM-oriented read, region_split.py:226-227) but these windows
-    # slice the PHYSICAL read (the mutually-revcomp UMI patterns make the
-    # pattern choice strand-agnostic), so the per-side budgets swap for
-    # reverse-strand reads: a minus read's physical 5' end carries the
-    # molecule's 3' structure. Symmetric-ish defaults (81/76) hide this;
-    # an asymmetric config (long 5' flank) would otherwise clip the
-    # fwd UMI out of minus reads' 3' window.
-    bw5 = jnp.where(is_rev, a3, a5)
-    bw3 = jnp.where(is_rev, a5, a3)
-    pos_w = jnp.arange(aw, dtype=jnp.int32)[None, :]
-    idx5 = jnp.clip(t_start[:, None] + pos_w, 0, W - 1)
-    w5 = jnp.take(jnp.asarray(encode.CODE_TO_MASK),
-                  jnp.take_along_axis(codes, idx5, axis=1).astype(jnp.int32))
-    w5 = jnp.where(pos_w < bw5[:, None], w5, jnp.uint8(0))
-    l5 = jnp.minimum(lens_t, bw5)
-    start3 = jnp.maximum(lens_t - bw3, 0)  # trimmed-frame coords (downstream)
-    idx3 = jnp.clip((t_start + start3)[:, None] + pos_w, 0, W - 1)
-    w3 = jnp.take(jnp.asarray(encode.CODE_TO_MASK),
-                  jnp.take_along_axis(codes, idx3, axis=1).astype(jnp.int32))
-    w3 = jnp.where(pos_w < bw3[:, None], w3, jnp.uint8(0))
-    l3 = jnp.minimum(lens_t, bw3)
-    ud, us, ue = fuzzy_match.fuzzy_find_multi(
-        umi_masks, umi_mask_lens,
-        jnp.concatenate([w5, w3], axis=0),
-        jnp.concatenate([l5, l3], axis=0),
-    )  # each (2, 2B)
-    d5, s5, e5 = ud[0, :B], us[0, :B], ue[0, :B]
-    d3, s3, e3 = ud[1, B:], us[1, B:], ue[1, B:]
+    umi_out = _umi_windows(
+        codes, lens_t, t_start, is_rev, umi_masks, umi_mask_lens, a5=a5, a3=a3
+    )
 
     blast_id = best["n_match"] / jnp.maximum(best["n_cols"], 1)
     return {
@@ -298,8 +384,7 @@ def _fused_pass(
         "blast_id": blast_id.astype(jnp.float32),
         "ref_start": best["ref_start"], "ref_end": best["ref_end"],
         "read_start": best["read_start"], "read_end": best["read_end"],
-        "d5": d5, "s5": s5, "e5": e5,
-        "d3": d3, "s3": s3, "e3": e3, "start3": start3,
+        **umi_out,
     }
 
 
@@ -523,6 +608,56 @@ class AssignEngine:
             return self._sharded_fn(has_quals)(*args)
         return _fused_pass(*args, **self._static_kwargs(has_quals))
 
+    def _sharded_targeted_fn(self, max_c: int):
+        """shard_map-wrapped targeted pass (same pattern as _sharded_fn)."""
+        key = ("targeted", max_c)
+        if key in self._sharded_cache:
+            return self._sharded_cache[key]
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        kwstat = dict(band_width=self.band_width, a5=self.a5, a3=self.a3,
+                      max_c=max_c)
+
+        def base(codes, lens, cand, *rest):
+            return _targeted_pass(codes, lens, cand, *rest, **kwstat)
+
+        d1, d2, rep = P("data"), P("data", None), P()
+        in_specs = (d2, d1, d2, rep, rep, rep, rep, rep)
+        out_specs = {
+            k: d1
+            for k in ("lens", "t_start", "ee_ok", "is_rev", "ridx", "score",
+                      "blast_id", "ref_start", "ref_end", "read_start",
+                      "read_end", "d5", "s5", "e5", "d3", "s3", "e3", "start3")
+        }
+        fn = jax.jit(shard_map(
+            base, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        ))
+        self._sharded_cache[key] = fn
+        return fn
+
+    def run_batch_targeted_async(
+        self, batch: bucketing.ReadBatch, cand_idx: np.ndarray, min_len: int,
+    ) -> dict[str, jax.Array]:
+        """Round-2 dispatch: align each read only against its candidate
+        refs (``cand_idx`` (B, max_c) int32, -1 padded); see
+        :func:`_targeted_pass`."""
+        max_c = int(cand_idx.shape[1])
+        args = (
+            jnp.asarray(batch.codes), jnp.asarray(batch.lengths),
+            jnp.asarray(cand_idx),
+            self.panel.d_codes, self.panel.d_lens,
+            self.umi_masks, self.umi_mask_lens,
+            jnp.int32(min_len),
+        )
+        if self.mesh is not None:
+            return self._sharded_targeted_fn(max_c)(*args)
+        return _targeted_pass(
+            *args, band_width=self.band_width, a5=self.a5, a3=self.a3,
+            max_c=max_c,
+        )
+
     def run_batch(self, batch: bucketing.ReadBatch, max_ee_rate: float,
                   min_len: int) -> dict[str, np.ndarray]:
         # ONE batched device->host transfer: per-array readback pays a flat
@@ -637,6 +772,7 @@ def run_assign(
     collect_qc: list | None = None,
     subsample: int | None = None,
     prefetch_depth: int = 2,
+    dispatch=None,
 ) -> tuple[ReadStore, AlignStats]:
     """Stream a fastx file or record iterable through the fused pass.
 
@@ -644,6 +780,10 @@ def run_assign(
     plus — when ``blast_id_threshold`` is set (round 2) — the consensus
     blast-id gate of minimap2_align.py:209-245. ``subsample`` mirrors
     ``dorado trim --max-reads`` head-subsampling (preprocessing.py:41-57).
+    ``dispatch`` overrides the per-batch device call (default: the engine's
+    fused pass) — round 2 passes the targeted-candidate dispatcher; every
+    downstream filter/consume step is shared, so filter semantics cannot
+    drift between the two paths.
 
     A path source uses the native C++ parser when the extension builds
     (io/native), falling back to the pure-Python parser; batch building is
@@ -795,7 +935,12 @@ def run_assign(
         ):
             if not acquire_permit():
                 break
-            out_dev = engine.run_batch_async(batch, max_ee_rate, min_len)
+            if dispatch is not None:
+                # gate params flow from THIS call site for both paths, so
+                # the EE/length filter cannot drift between them
+                out_dev = dispatch(batch, max_ee_rate, min_len)
+            else:
+                out_dev = engine.run_batch_async(batch, max_ee_rate, min_len)
             inflight.put((batch, out_dev))
     finally:
         inflight.put(_PREFETCH_DONE)

@@ -118,6 +118,9 @@ class RunConfig:
     cluster_batch_size: int | None = None  # None -> derived per tile shape
     umi_batch_size: int = 4096        # UMIs per distance-matrix tile
     max_read_length: int = 4096       # padded read width cap
+    round2_targeted_assign: bool = True  # align consensus only against its
+    #   round-1 region cluster's refs (skip sketch/strand re-derivation);
+    #   False restores the full fused pass for round 2
     mesh_shape: dict[str, int] | None = None  # e.g. {"data": 8}
     distributed: bool = False         # multi-host: jax.distributed init +
     #   shard-by-barcode across processes (parallel/distributed.py)
