@@ -102,6 +102,39 @@ def batch_reads(
             yield flush(w)
 
 
+def _rows_to_batch(
+    rows: list, w: int, batch_size: int, has_quals: bool,
+) -> ReadBatch:
+    """Materialize one padded batch from (codes, quals|None, name) rows.
+
+    THE single place that owns the padded-batch policy (pow2-of-real-count
+    floor 64, PAD_CODE fill, qual filler 93, ''-padded ids) for the
+    columnar ingest paths — batch_parsed_reads and batch_parsed_chunks
+    must stay shape-identical on the same data.
+
+    A final partial batch pads to the pow2 of its REAL count (floor 64
+    keeps mesh divisibility and compile classes bounded): the round-2
+    consensus pass and tail batches otherwise pay full-batch compute for a
+    handful of rows (CPU breakdown: round2 ~= round1 cost).
+    """
+    B = min(batch_size, pow2_ceil(len(rows), 64))
+    codes = np.full((B, w), encode.PAD_CODE, dtype=np.uint8)
+    quals = np.full((B, w), 93, dtype=np.uint8) if has_quals else None
+    blens = np.zeros((B,), dtype=np.int32)
+    valid = np.zeros((B,), dtype=bool)
+    ids: list[str] = []
+    for i, (c, q, nm) in enumerate(rows):
+        codes[i, : c.size] = c
+        if has_quals and q is not None:
+            quals[i, : q.size] = q
+        blens[i] = c.size
+        valid[i] = True
+        ids.append(nm)
+    ids.extend([""] * (B - len(rows)))
+    return ReadBatch(codes=codes, quals=quals, lengths=blens, valid=valid,
+                     ids=ids, width=w)
+
+
 def batch_parsed_reads(
     parsed,
     batch_size: int = 2048,
@@ -129,33 +162,89 @@ def batch_parsed_reads(
     def flush(w: int) -> ReadBatch:
         rows = pending[w]
         pending[w] = []
-        # a final partial batch pads to the pow2 of its REAL count (floor 64
-        # keeps mesh divisibility and compile classes bounded): the round-2
-        # consensus pass and tail batches otherwise pay full-batch compute
-        # for a handful of rows (CPU breakdown: round2 ~= round1 cost)
-        B = min(batch_size, pow2_ceil(len(rows), 64))
-        codes = np.full((B, w), encode.PAD_CODE, dtype=np.uint8)
-        quals = np.full((B, w), 93, dtype=np.uint8) if has_quals else None
-        blens = np.zeros((B,), dtype=np.int32)
-        valid = np.zeros((B,), dtype=bool)
-        ids: list[str] = []
-        for i, r in enumerate(rows):
-            s, e = parsed.offsets[r], parsed.offsets[r + 1]
-            codes[i, : e - s] = parsed.codes[s:e]
-            if has_quals:
-                quals[i, : e - s] = parsed.quals[s:e]
-            blens[i] = e - s
-            valid[i] = True
-            ids.append(parsed.names[r])
-        ids.extend([""] * (B - len(rows)))
-        return ReadBatch(codes=codes, quals=quals, lengths=blens, valid=valid,
-                         ids=ids, width=w)
+        return _rows_to_batch(
+            [
+                (
+                    parsed.codes[parsed.offsets[r]:parsed.offsets[r + 1]],
+                    parsed.quals[parsed.offsets[r]:parsed.offsets[r + 1]]
+                    if has_quals else None,
+                    parsed.names[r],
+                )
+                for r in rows
+            ],
+            w, batch_size, has_quals,
+        )
 
     for r in np.where(eligible)[0]:
         w = int(widths_arr[bucket_idx[r]])
         pending[w].append(int(r))
         if len(pending[w]) == batch_size:
             yield flush(w)
+    for w in widths:
+        if pending[int(w)]:
+            yield flush(int(w))
+
+
+def batch_parsed_chunks(
+    chunks,
+    batch_size: int = 2048,
+    widths: Sequence[int] = DEFAULT_WIDTHS,
+    min_len: int = 1,
+    subsample: int | None = None,
+) -> Iterator[ReadBatch]:
+    """:func:`batch_parsed_reads` over a STREAM of ParsedFastx chunks.
+
+    Buckets carry across chunk boundaries so batch shapes are identical to
+    a whole-file parse of the same data (no partial flush per chunk — the
+    compile-class story is unchanged). Pending rows are copied out of a
+    finished chunk (<= batch_size rows/bucket, a few MB) so each chunk's
+    big columnar arrays free as soon as it is consumed: peak host memory
+    is O(chunk + pending), not O(file) — SURVEY §7 hard-part 5.
+    """
+    widths_arr = np.asarray(widths)
+    # pending entries: (codes_row, quals_row_or_None, name)
+    pending: dict[int, list[tuple]] = {int(w): [] for w in widths}
+    has_quals = False
+    taken = 0
+
+    def flush(w: int) -> ReadBatch:
+        rows = pending[w]
+        pending[w] = []
+        return _rows_to_batch(rows, w, batch_size, has_quals)
+
+    for parsed in chunks:
+        if parsed.quals is not None:
+            has_quals = True
+        n_raw = parsed.num_records
+        # head-subsample counts RAW records (dorado trim --max-reads
+        # semantics, preprocessing.py:41-57) — ineligible reads spend
+        # quota too, matching the pure-Python fallback path exactly
+        if subsample is not None:
+            n_raw = min(n_raw, subsample - taken)
+            taken += n_raw
+        lens = np.asarray(parsed.lengths)[:n_raw]
+        bucket_idx = np.searchsorted(widths_arr, lens)
+        eligible = np.where((lens >= min_len) & (bucket_idx < len(widths_arr)))[0]
+        for r in eligible:
+            w = int(widths_arr[bucket_idx[r]])
+            s, e = parsed.offsets[r], parsed.offsets[r + 1]
+            pending[w].append((
+                parsed.codes[s:e],
+                parsed.quals[s:e] if parsed.quals is not None else None,
+                parsed.names[r],
+            ))
+            if len(pending[w]) == batch_size:
+                yield flush(w)
+        # copy leftover VIEWS (base is the chunk's big array) so the chunk
+        # can free; rows copied at earlier boundaries are already owned
+        for w in widths:
+            pending[int(w)] = [
+                (c if c.base is None else c.copy(),
+                 q if q is None or q.base is None else q.copy(), nm)
+                for c, q, nm in pending[int(w)]
+            ]
+        if subsample is not None and taken >= subsample:
+            break
     for w in widths:
         if pending[int(w)]:
             yield flush(int(w))

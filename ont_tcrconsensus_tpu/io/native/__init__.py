@@ -64,6 +64,14 @@ def load() -> ctypes.CDLL | None:
         ]
         lib.fastx_free.restype = None
         lib.fastx_free.argtypes = [ctypes.c_void_p]
+        lib.fastx_open.restype = ctypes.c_void_p
+        lib.fastx_open.argtypes = [ctypes.c_char_p]
+        lib.fastx_stream_error.restype = ctypes.c_char_p
+        lib.fastx_stream_error.argtypes = [ctypes.c_void_p]
+        lib.fastx_next_chunk.restype = ctypes.c_void_p
+        lib.fastx_next_chunk.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.fastx_close.restype = None
+        lib.fastx_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -91,15 +99,8 @@ class ParsedFastx:
         )
 
 
-def parse_file(path: str | os.PathLike[str]) -> ParsedFastx | None:
-    """Parse with the native library; None when the library is unavailable.
-
-    Raises ValueError on malformed input (same contract as fastx.read_fastx).
-    """
-    lib = load()
-    if lib is None:
-        return None
-    handle = lib.fastx_parse(os.fspath(path).encode())
+def _copy_out(lib, handle, path) -> ParsedFastx:
+    """Copy a native ParsedFile handle into numpy arrays (then free it)."""
     try:
         err = lib.fastx_error(handle)
         if err:
@@ -125,3 +126,51 @@ def parse_file(path: str | os.PathLike[str]) -> ParsedFastx | None:
                            offsets=offsets, names=names)
     finally:
         lib.fastx_free(handle)
+
+
+def parse_file(path: str | os.PathLike[str]) -> ParsedFastx | None:
+    """Parse with the native library; None when the library is unavailable.
+
+    Raises ValueError on malformed input (same contract as fastx.read_fastx).
+    Materializes the WHOLE file — fine for references and tests; lane-scale
+    read files go through :func:`parse_chunks` (SURVEY §7 hard-part 5).
+    """
+    lib = load()
+    if lib is None:
+        return None
+    handle = lib.fastx_parse(os.fspath(path).encode())
+    return _copy_out(lib, handle, path)
+
+
+def parse_chunks(
+    path: str | os.PathLike[str], chunk_bases: int = 32 << 20,
+):
+    """Generator of ParsedFastx chunks with O(chunk) host memory.
+
+    Yields nothing (and returns) when the native library is unavailable —
+    callers must check :func:`available` first or fall back themselves.
+    Raises ValueError on malformed input, like :func:`parse_file`.
+    """
+    lib = load()
+    if lib is None:
+        return
+    stream = lib.fastx_open(os.fspath(path).encode())
+    try:
+        err = lib.fastx_stream_error(stream)
+        if err:
+            raise ValueError(f"{path}: {err.decode()}")
+        while True:
+            handle = lib.fastx_next_chunk(stream, chunk_bases)
+            if not handle:
+                err = lib.fastx_stream_error(stream)
+                if err:
+                    raise ValueError(f"{path}: {err.decode()}")
+                return
+            yield _copy_out(lib, handle, path)
+    finally:
+        lib.fastx_close(stream)
+
+
+def available() -> bool:
+    """True when the native parser builds/loads on this host."""
+    return load() is not None

@@ -76,6 +76,161 @@ bool next_line(const std::string& s, size_t* pos, size_t* start, size_t* end) {
   return true;
 }
 
+// next line, also reporting whether the line is TERMINATED (a '\n' was
+// seen) — a streaming chunk may end mid-line, and an unterminated line is
+// only trustworthy at EOF
+bool next_line_t(const std::string& s, size_t* pos, size_t* start, size_t* end,
+                 bool* terminated) {
+  if (*pos >= s.size()) return false;
+  *start = *pos;
+  size_t nl = s.find('\n', *pos);
+  if (nl == std::string::npos) {
+    *end = s.size();
+    *pos = s.size();
+    *terminated = false;
+  } else {
+    *end = nl;
+    *pos = nl + 1;
+    *terminated = true;
+  }
+  if (*end > *start && s[*end - 1] == '\r') --*end;
+  return true;
+}
+
+void emit_record(ParsedFile* out, const std::string& data, size_t ha, size_t hb,
+                 const std::string& seq) {
+  const uint8_t* lut = code_lut();
+  for (char c : seq) out->codes.push_back(lut[(uint8_t)c]);
+  out->lengths.push_back((int32_t)seq.size());
+  out->offsets.push_back((int64_t)out->codes.size());
+  out->names.append(data, ha, hb - ha);
+  out->names += '\n';
+}
+
+// Incremental parse: consume COMPLETE records from data into out, set
+// *consumed to the byte offset after the last fully-parsed record (the
+// caller carries the tail into the next chunk). When at_eof, a trailing
+// partial record is an error (FASTQ) or final record (FASTA) exactly like
+// the whole-file parser.
+bool parse_stream_buffer(const std::string& data, bool at_eof, char* kind_io,
+                         ParsedFile* out, size_t* consumed) {
+  const uint8_t* lut = code_lut();
+  size_t pos = 0, a, b;
+  bool term;
+  *consumed = 0;
+  out->offsets.push_back(0);
+  // skip leading blank lines
+  size_t scan = 0;
+  bool any = false;
+  while (next_line_t(data, &scan, &a, &b, &term)) {
+    if (a == b) { *consumed = scan; continue; }
+    any = true;
+    break;
+  }
+  if (!any) { *consumed = data.size(); return true; }  // blanks only
+  if (*kind_io == 0) {
+    char kind = data[a];
+    if (kind != '@' && kind != '>') {
+      out->error = "not FASTA/FASTQ";
+      return false;
+    }
+    *kind_io = kind;
+  }
+  out->has_qual = *kind_io == '@';
+  pos = a;  // first record header start
+
+  if (*kind_io == '>') {
+    std::string seq;
+    size_t ha = 0, hb = 0;
+    size_t rec_start = pos;
+    bool have = false;
+    while (true) {
+      size_t line_pos = pos;
+      if (!next_line_t(data, &pos, &a, &b, &term)) break;
+      if (a == b) continue;
+      if (data[a] == '>') {
+        if (have) {
+          emit_record(out, data, ha, hb, seq);
+          *consumed = line_pos;
+        }
+        rec_start = line_pos;
+        if (!term && !at_eof) { have = false; break; }  // partial header
+        ha = a + 1;
+        hb = b;
+        seq.clear();
+        have = true;
+      } else {
+        if (!term && !at_eof) break;  // possibly split sequence line
+        seq.append(data, a, b - a);
+      }
+    }
+    if (at_eof) {
+      if (have) emit_record(out, data, ha, hb, seq);
+      *consumed = data.size();
+    }
+    // non-EOF: the record from rec_start onward stays in the carry (a
+    // FASTA record is only known complete at the next header/EOF)
+    (void)rec_start;
+    return true;
+  }
+
+  // FASTQ: strict 4-line records, blank lines tolerated between records
+  while (true) {
+    size_t rec_start;
+    bool got = false;
+    while (next_line_t(data, &pos, &a, &b, &term)) {
+      if (a == b) continue;
+      rec_start = a;
+      got = true;
+      break;
+    }
+    if (!got) { *consumed = data.size(); break; }
+    if (data[a] != '@') {
+      out->error = "malformed FASTQ header";
+      return false;
+    }
+    if (!term && !at_eof) { *consumed = rec_start; break; }
+    size_t ha = a + 1, hb = b;
+    size_t sa, sb, pa, pb, qa, qb;
+    bool t2, t3, t4;
+    if (!next_line_t(data, &pos, &sa, &sb, &t2) ||
+        !next_line_t(data, &pos, &pa, &pb, &t3) ||
+        !next_line_t(data, &pos, &qa, &qb, &t4)) {
+      if (at_eof) {
+        out->error = "truncated FASTQ record";
+        return false;
+      }
+      *consumed = rec_start;
+      break;
+    }
+    if (!at_eof && !t4) { *consumed = rec_start; break; }  // quals may grow
+    if (pa == pb || data[pa] != '+') {
+      out->error = "malformed FASTQ record (missing +)";
+      return false;
+    }
+    size_t slen = sb - sa, qlen = qb - qa;
+    if (slen != qlen) {
+      out->error = "FASTQ qual length != seq length";
+      return false;
+    }
+    for (size_t i = sa; i < sb; ++i) out->codes.push_back(lut[(uint8_t)data[i]]);
+    for (size_t i = qa; i < qb; ++i) {
+      uint8_t q = (uint8_t)data[i];
+      if (q < 33) {
+        out->error = "quality below Phred-33 '!'";
+        return false;
+      }
+      out->quals.push_back(q - 33);
+    }
+    out->lengths.push_back((int32_t)slen);
+    out->offsets.push_back((int64_t)out->codes.size());
+    out->names.append(data, ha, hb - ha);
+    out->names += '\n';
+    *consumed = pos;
+  }
+  return true;
+}
+
 bool parse_buffer(const std::string& data, ParsedFile* out) {
   const uint8_t* lut = code_lut();
   size_t pos = 0, a, b;
@@ -213,5 +368,86 @@ void fastx_copy(void* h, uint8_t* codes, uint8_t* quals, int32_t* lengths,
 }
 
 void fastx_free(void* h) { delete (ParsedFile*)h; }
+
+// --- streaming API: O(chunk) host memory for lane-scale files ------------
+//
+// fastx_open -> repeated fastx_next_chunk(target_bases) -> fastx_close.
+// Each chunk is a ParsedFile handle consumed with the same accessors as
+// fastx_parse; nullptr means clean EOF. A 100+ GB lane (SURVEY §7
+// hard-part 5) streams through a fixed-size carry buffer instead of being
+// materialized whole.
+
+struct FastxStream {
+  gzFile fh = nullptr;
+  std::string carry;
+  bool eof = false;
+  char kind = 0;  // '@' or '>', discovered on first chunk
+  std::string error;
+};
+
+void* fastx_open(const char* path) {
+  auto* s = new FastxStream();
+  s->fh = gzopen(path, "rb");
+  if (!s->fh) s->error = "cannot open file";
+  return s;
+}
+
+const char* fastx_stream_error(void* h) {
+  auto* s = (FastxStream*)h;
+  return s->error.empty() ? nullptr : s->error.c_str();
+}
+
+void* fastx_next_chunk(void* h, int64_t target_bases) {
+  auto* s = (FastxStream*)h;
+  if (!s->error.empty()) return nullptr;
+  if (s->eof && s->carry.empty()) return nullptr;
+  // FASTQ carries ~2 bytes per base (seq+qual) plus headers; aim the raw
+  // buffer at ~2.5x the requested decoded bases. If no complete record
+  // fits (one record larger than the buffer), double and retry — progress
+  // is guaranteed, so the loop terminates.
+  size_t want = (size_t)(target_bases > 0 ? target_bases : (16 << 20)) * 5 / 2;
+  char buf[1 << 16];
+  ParsedFile* out = nullptr;
+  while (true) {
+    while (!s->eof && s->carry.size() < want) {
+      int n = gzread(s->fh, buf, sizeof(buf));
+      if (n > 0) {
+        s->carry.append(buf, n);
+      } else if (n == 0) {
+        s->eof = true;
+      } else {
+        s->error = "read/decompress error";
+        return nullptr;
+      }
+    }
+    out = new ParsedFile();
+    size_t consumed = 0;
+    if (!parse_stream_buffer(s->carry, s->eof, &s->kind, out, &consumed)) {
+      s->error = out->error;  // surface via the chunk handle too
+      return out;
+    }
+    s->carry.erase(0, consumed);
+    if (!out->lengths.empty() || s->eof) break;
+    delete out;
+    want *= 2;
+  }
+  if (out->lengths.empty() && s->eof && !s->carry.empty()) {
+    // EOF but unconsumed bytes and no records: malformed tail
+    out->error = "trailing unparseable data";
+    s->error = out->error;
+    return out;
+  }
+  if (out->lengths.empty() && s->eof) {
+    delete out;
+    return nullptr;
+  }
+  return out;
+}
+
+void fastx_close(void* h) {
+  auto* s = (FastxStream*)h;
+  if (s->fh) gzclose(s->fh);
+  delete s;
+}
 
 }  // extern "C"

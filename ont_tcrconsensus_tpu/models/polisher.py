@@ -329,11 +329,29 @@ def load_params(path: str) -> dict:
         return flax.serialization.from_bytes(template, fh.read())
 
 
-DEFAULT_WEIGHTS = os.path.join(os.path.dirname(__file__), "weights", "polisher_v2.msgpack")
+_WEIGHTS_DIR = os.path.join(os.path.dirname(__file__), "weights")
+DEFAULT_WEIGHTS = os.path.join(_WEIGHTS_DIR, "polisher_v2.msgpack")
+# newest bundled generation wins (v3: held-out-regime training, VERDICT r3 #3)
+_WEIGHT_PREFERENCE = (
+    os.path.join(_WEIGHTS_DIR, "polisher_v3.msgpack"),
+    DEFAULT_WEIGHTS,
+)
+
+
+def serving_weights_path() -> str:
+    """The weights file the pipeline actually serves (newest existing
+    generation; DEFAULT_WEIGHTS when none exists yet). train._main targets
+    this by default so retraining can never silently write a file the
+    pipeline ignores."""
+    for path in _WEIGHT_PREFERENCE:
+        if os.path.exists(path):
+            return path
+    return DEFAULT_WEIGHTS
 
 
 def load_default_params() -> dict | None:
-    """Bundled in-repo weights, or None when not present."""
-    if os.path.exists(DEFAULT_WEIGHTS):
-        return load_params(DEFAULT_WEIGHTS)
+    """Bundled in-repo weights (newest generation first), or None."""
+    path = serving_weights_path()
+    if os.path.exists(path):
+        return load_params(path)
     return None

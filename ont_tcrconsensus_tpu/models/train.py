@@ -31,6 +31,41 @@ from ont_tcrconsensus_tpu.ops import consensus, encode, pileup
 # training/eval default: the systematic error model at ONT-sup-like rates
 DEFAULT_ERROR_MODEL = simulator.OntErrorModel()
 
+# --- v3 de-circularization (VERDICT r3 #3) -------------------------------
+# v2 trained AND evaluated on the same generative family (different seeds
+# only), so the eval could not fail off-distribution. v3 trains on a
+# RANDOMIZED family of parameterizations and evaluates on held-out regimes
+# whose parameters (homopolymer slope/cap, context family, transition
+# fraction) were never seen in training — plus the iid model, which shares
+# NO structure with the training family.
+TRAIN_REGIMES: tuple[simulator.OntErrorModel, ...] = (
+    simulator.OntErrorModel(),
+    simulator.OntErrorModel(sub_rate=0.009, ins_rate=0.003, del_rate=0.006),
+    simulator.OntErrorModel(hp_slope=0.6, hp_cap=6.0),
+    simulator.OntErrorModel(
+        motif_sub_boost=(("GA", 2.0), ("CT", 3.5), ("TC", 1.5)),
+        transition_frac=0.75,
+    ),
+)
+
+# held out: parameters OUTSIDE the training family's ranges/context sets
+HELDOUT_REGIMES: dict[str, simulator.OntErrorModel | None] = {
+    # stronger homopolymer shrinkage than any training regime
+    "hp_shift": simulator.OntErrorModel(
+        hp_slope=1.6, hp_cap=14.0, del_rate=0.006
+    ),
+    # a context-bias family disjoint from the training one, lower
+    # transition fraction than any training regime
+    "ctx_shift": simulator.OntErrorModel(
+        motif_sub_boost=(("AG", 3.0), ("TG", 2.5), ("CA", 2.0)),
+        transition_frac=0.4,
+    ),
+    # no systematic structure at all (legacy iid rates)
+    "iid": None,
+    # the v2 regime, kept for continuity with polisher_v2_eval.json
+    "in_family": simulator.OntErrorModel(),
+}
+
 
 @dataclasses.dataclass
 class ExampleBatch:
@@ -65,6 +100,7 @@ def make_examples(
     error_model: simulator.OntErrorModel | None = DEFAULT_ERROR_MODEL,
     rounds: int = 4,
     err_weight: float = 50.0,
+    error_models: tuple | None = None,
 ) -> ExampleBatch:
     """Build supervised examples from simulated low-depth clusters.
 
@@ -90,11 +126,13 @@ def make_examples(
         width = _auto_width(template_len)
     rng = np.random.default_rng(seed)
     feats_l, labels_l, ins_l, mask_l = [], [], [], []
-    for _ in range(n_examples):
+    for n in range(n_examples):
         template = simulator._rand_seq(rng, template_len)
         depth = int(rng.integers(depth_range[0], depth_range[1] + 1))
+        # v3 domain randomization: cycle the regime per example
+        em = error_models[n % len(error_models)] if error_models else error_model
         reads = [
-            _simulate_read(rng, template, err, error_model)
+            _simulate_read(rng, template, err, em)
             for _ in range(depth)
         ]
         codes = np.full((depth, width), encode.PAD_CODE, np.uint8)
@@ -159,10 +197,14 @@ def train(
     params=None,
     log_every: int = 50,
     error_model: simulator.OntErrorModel | None = DEFAULT_ERROR_MODEL,
+    error_models: tuple | None = None,
+    depth_range: tuple[int, int] = (2, 8),
 ) -> tuple[dict, list[float]]:
     """Train the polisher; returns (params, loss trace)."""
     pool = make_examples(
-        seed, pool_examples, template_len=template_len, error_model=error_model
+        seed, pool_examples, template_len=template_len,
+        error_model=error_model, error_models=error_models,
+        depth_range=depth_range,
     )
     if params is None:
         params = polisher.init_params(seed)
@@ -276,6 +318,34 @@ def evaluate_consensus_gain(
     return out
 
 
+def evaluate_regimes(
+    params,
+    regimes: dict[str, simulator.OntErrorModel | None] = None,
+    seed: int = 101,
+    n_clusters: int = 250,
+    template_len: int = 1600,
+    depths: tuple[int, ...] = (2, 3, 4, 6, 10),
+    min_confidence: float = 0.9,
+) -> dict[str, dict[int, dict[str, float]]]:
+    """Per-regime precision-at-depth tables on HELD-OUT error regimes.
+
+    The v3 honesty contract (VERDICT r3 #3): the eval can fail — the
+    regimes' parameters were never seen in training (hp_shift / ctx_shift)
+    or share no structure with it at all (iid). Seeds differ per regime so
+    templates are independent draws too.
+    """
+    if regimes is None:
+        regimes = HELDOUT_REGIMES
+    out: dict[str, dict[int, dict[str, float]]] = {}
+    for i, (name, model) in enumerate(sorted(regimes.items())):
+        out[name] = evaluate_consensus_gain(
+            params, seed=seed + 31 * i, n_clusters=n_clusters,
+            template_len=template_len, depths=depths,
+            error_model=model, min_confidence=min_confidence,
+        )
+    return out
+
+
 def evaluate_accuracy(params, seed: int = 99, n_examples: int = 32) -> dict[str, float]:
     """Per-position accuracy of the polisher vs the raw draft on held-out data."""
     ex = make_examples(seed, n_examples)
@@ -305,6 +375,7 @@ def _main(argv=None) -> int:
     """
     import argparse
     import json
+    import os
 
     from ont_tcrconsensus_tpu.models.polisher import DEFAULT_WEIGHTS, save_params
 
@@ -314,12 +385,38 @@ def _main(argv=None) -> int:
     parser.add_argument("--pool-examples", type=int, default=128)
     parser.add_argument("--batch-size", type=int, default=8)
     parser.add_argument("--seed", type=int, default=0)
-    parser.add_argument("--out", default=DEFAULT_WEIGHTS)
+    parser.add_argument("--out", default=None,
+                        help="weights path (default: the file the pipeline "
+                             "serves — polisher.serving_weights_path())")
     parser.add_argument("--eval-only", action="store_true")
     parser.add_argument("--eval-clusters", type=int, default=500)
     parser.add_argument("--iid", action="store_true",
                         help="legacy iid error model (ablation only)")
+    parser.add_argument("--v3", action="store_true",
+                        help="v3 flow: train on the randomized regime "
+                             "family, evaluate on held-out regimes, write "
+                             "polisher_v3.msgpack + polisher_v3_eval.json")
+    parser.add_argument("--eval-json", default=None,
+                        help="also write the eval table to this path")
+    parser.add_argument("--depth-max", type=int, default=8,
+                        help="max subread depth in training examples")
     args = parser.parse_args(argv)
+
+    if args.v3 and args.iid:
+        parser.error("--v3 trains on the regime family; --iid is the "
+                     "single-regime ablation — pick one")
+    weights_dir = os.path.dirname(DEFAULT_WEIGHTS)
+    if args.out is None:
+        if args.v3:
+            args.out = os.path.join(weights_dir, "polisher_v3.msgpack")
+        else:
+            # target what the pipeline SERVES so a default retrain can
+            # never write a file load_default_params ignores
+            from ont_tcrconsensus_tpu.models.polisher import serving_weights_path
+
+            args.out = serving_weights_path()
+    if args.v3 and args.eval_json is None:
+        args.eval_json = os.path.join(weights_dir, "polisher_v3_eval.json")
 
     error_model = None if args.iid else DEFAULT_ERROR_MODEL
     if args.eval_only:
@@ -331,14 +428,26 @@ def _main(argv=None) -> int:
             steps=args.steps, batch_size=args.batch_size, seed=args.seed,
             pool_examples=args.pool_examples, template_len=args.template_len,
             error_model=error_model,
+            error_models=TRAIN_REGIMES if args.v3 else None,
+            depth_range=(2, args.depth_max),
         )
         save_params(params, args.out)
         print(f"saved {args.out} (final loss {losses[-1]:.4f})")
-    gain = evaluate_consensus_gain(
-        params, template_len=args.template_len, n_clusters=args.eval_clusters,
-        error_model=error_model,
-    )
+    if args.v3:
+        gain = evaluate_regimes(
+            params, template_len=args.template_len,
+            n_clusters=args.eval_clusters,
+        )
+    else:
+        gain = evaluate_consensus_gain(
+            params, template_len=args.template_len,
+            n_clusters=args.eval_clusters, error_model=error_model,
+        )
     print(json.dumps(gain, indent=2))
+    if args.eval_json:
+        with open(args.eval_json, "w") as fh:
+            json.dump(gain, fh, indent=2)
+        print(f"wrote {args.eval_json}")
     return 0
 
 

@@ -723,23 +723,38 @@ def _batches_from_source(source, batch_size, widths, subsample):
     if isinstance(source, (str, os.PathLike)):
         from ont_tcrconsensus_tpu.io import native
 
-        parsed = None
+        # STREAMED ingest: O(chunk) host memory, so a 100+ GB lane never
+        # materializes (SURVEY §7 hard-part 5; VERDICT r3 #5). Batch shapes
+        # are identical to a whole-file parse. The FIRST chunk is pulled
+        # eagerly so early malformed input / native failures surface here
+        # (falling back to the pure-Python parser before anything was
+        # consumed); a failure DEEPER in the file necessarily raises
+        # mid-stream — the price of not materializing the whole file.
+        chunk_iter = None
+        first_cell: list = []
         try:
-            parsed = native.parse_file(source)
+            if native.available():
+                chunk_iter = native.parse_chunks(source)
+                first = next(chunk_iter, None)
+                if first is not None:
+                    first_cell.append(first)
+                del first
         except ValueError:
             raise
         except Exception:
-            parsed = None
-        if parsed is not None:
-            if subsample is not None and parsed.num_records > subsample:
-                parsed = dataclasses.replace(
-                    parsed,
-                    lengths=parsed.lengths[:subsample],
-                    offsets=parsed.offsets[: subsample + 1],
-                    names=parsed.names[:subsample],
-                )
-            return bucketing.batch_parsed_reads(
-                parsed, batch_size=batch_size, widths=widths, min_len=1
+            chunk_iter = None
+        if chunk_iter is not None:
+            def chunks():
+                while first_cell:
+                    # pop so the eager first chunk frees after consumption
+                    # instead of staying pinned for the whole ingest
+                    yield first_cell.pop()
+                yield from chunk_iter
+
+            return bucketing.batch_parsed_chunks(
+                chunks(),
+                batch_size=batch_size, widths=widths, min_len=1,
+                subsample=subsample,
             )
         source = fastx.read_fastx(source)
 

@@ -490,9 +490,11 @@ def _targeted_round2_dispatch(panel, engine, headers):
     Consensus headers carry their round-1 region cluster
     (``region_cluster<K>_cluster<id>_<n>``, stages.polish_clusters_all),
     so round 2 aligns each consensus only against cluster K's references
-    instead of re-deriving candidates from the full panel. Returns None
-    when any header lacks provenance (e.g. a hand-fed fasta) — the caller
-    then keeps the full fused pass.
+    instead of re-deriving candidates from the full panel. Returns
+    ``(dispatch, None)``, or ``(None, reason)`` when the targeted pass is
+    unavailable (header without provenance — e.g. a hand-fed fasta — or a
+    pathological oversized cluster); the caller then keeps the full fused
+    pass and logs the reason.
     """
     cluster_refs: dict[int, np.ndarray] = {}
     for k in np.unique(panel.cluster_of_region):
@@ -511,10 +513,10 @@ def _targeted_round2_dispatch(panel, engine, headers):
     for h in headers:
         k = cluster_of(h)
         if k is None:
-            return None
+            return None, f"header {h.partition(' ')[0]!r} lacks cluster provenance"
         seen.add(k)
     if not seen:
-        return None
+        return None, "no consensus sequences"
     # ONE static candidate width for the whole round (pow2 so at most a
     # handful of jit shapes ever exist), computed from the clusters that
     # actually occur. A pathological panel whose homology chaining built a
@@ -522,7 +524,7 @@ def _targeted_round2_dispatch(panel, engine, headers):
     # under max_c unrolled SW passes — fall back.
     max_c = bucketing.pow2_ceil(max(len(cluster_refs[k]) for k in seen))
     if max_c > 8:
-        return None
+        return None, f"largest region cluster has >{8} refs (max_c={max_c})"
 
     def dispatch(batch, max_ee_rate, min_len):
         cand = np.full((len(batch.ids), max_c), -1, np.int32)
@@ -532,7 +534,7 @@ def _targeted_round2_dispatch(panel, engine, headers):
                 cand[row, : len(refs)] = refs
         return engine.run_batch_targeted_async(batch, cand, min_len=min_len)
 
-    return dispatch
+    return dispatch, None
 
 
 def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
@@ -546,11 +548,11 @@ def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
     qc_rows: list[dict] = []
     dispatch = None
     if cfg.round2_targeted_assign:
-        dispatch = _targeted_round2_dispatch(
+        dispatch, why_not = _targeted_round2_dispatch(
             panel, engine_notrim, (h for h, _ in merged_consensus)
         )
         if dispatch is None:
-            _log("round 2: consensus headers lack cluster provenance; "
+            _log(f"round 2: targeted assign unavailable ({why_not}); "
                  "falling back to the full fused assign")
     with timer.stage("round2_fused_assign"):
         cons_store, cstats = stages.run_assign(

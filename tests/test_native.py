@@ -107,3 +107,105 @@ def test_batch_parsed_matches_batch_reads(tmp_path):
         np.testing.assert_array_equal(x.quals, y.quals)
         np.testing.assert_array_equal(x.lengths, y.lengths)
         np.testing.assert_array_equal(x.valid, y.valid)
+
+
+def _write_big_fastq(path, n=3000, seed=5):
+    rng = np.random.default_rng(seed)
+    reads = []
+    for i in range(n):
+        ln = int(rng.integers(40, 400))
+        seq = "".join(rng.choice(list("ACGT"), size=ln))
+        qual = "".join(chr(33 + int(q)) for q in rng.integers(2, 40, size=ln))
+        reads.append((f"r{i} mol={i}", seq, qual))
+    fastx.write_fastq(path, reads)
+    return reads
+
+
+def test_parse_chunks_concat_equals_parse_file(tmp_path):
+    """Streamed chunks, concatenated, must be byte-identical to the
+    whole-file parse — small chunk_bases forces many chunk boundaries,
+    exercising the carry/split logic on both record kinds."""
+    path = str(tmp_path / "big.fastq.gz")
+    _write_big_fastq(path)
+    whole = native.parse_file(path)
+    chunks = list(native.parse_chunks(path, chunk_bases=16_384))
+    assert len(chunks) > 5, "chunking did not actually chunk"
+    assert sum(c.num_records for c in chunks) == whole.num_records
+    np.testing.assert_array_equal(
+        np.concatenate([c.codes for c in chunks]), whole.codes
+    )
+    np.testing.assert_array_equal(
+        np.concatenate([c.quals for c in chunks]), whole.quals
+    )
+    np.testing.assert_array_equal(
+        np.concatenate([c.lengths for c in chunks]), whole.lengths
+    )
+    assert [n for c in chunks for n in c.names] == whole.names
+
+    # FASTA too (multi-line records split across chunk boundaries; the
+    # stream reads 64 KB blocks, so the fixture must span several blocks)
+    fpath = str(tmp_path / "big.fasta")
+    fastx.write_fasta(
+        fpath, [(f"s{i}", "ACGTTGCA" * (10 + i % 37)) for i in range(1500)],
+        width=60,
+    )
+    whole = native.parse_file(fpath)
+    chunks = list(native.parse_chunks(fpath, chunk_bases=4096))
+    assert len(chunks) > 3
+    assert sum(c.num_records for c in chunks) == whole.num_records
+    np.testing.assert_array_equal(
+        np.concatenate([c.codes for c in chunks]), whole.codes
+    )
+    assert [n for c in chunks for n in c.names] == whole.names
+
+
+def test_batch_parsed_chunks_matches_whole_file(tmp_path):
+    """Cross-chunk batching must produce the SAME batches (shapes, order,
+    content) as batching the whole-file parse."""
+    from ont_tcrconsensus_tpu.io import bucketing
+
+    path = str(tmp_path / "big2.fastq.gz")
+    _write_big_fastq(path, n=2000, seed=9)
+    whole = native.parse_file(path)
+    want = list(bucketing.batch_parsed_reads(
+        whole, batch_size=256, widths=(128, 512), min_len=50
+    ))
+    got = list(bucketing.batch_parsed_chunks(
+        native.parse_chunks(path, chunk_bases=16_384),
+        batch_size=256, widths=(128, 512), min_len=50,
+    ))
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert g.width == w.width and g.ids == w.ids
+        np.testing.assert_array_equal(g.codes, w.codes)
+        np.testing.assert_array_equal(g.quals, w.quals)
+        np.testing.assert_array_equal(g.lengths, w.lengths)
+        np.testing.assert_array_equal(g.valid, w.valid)
+
+
+def test_batch_parsed_chunks_subsample(tmp_path):
+    from ont_tcrconsensus_tpu.io import bucketing
+
+    path = str(tmp_path / "big3.fastq.gz")
+    _write_big_fastq(path, n=500, seed=13)
+    got = list(bucketing.batch_parsed_chunks(
+        native.parse_chunks(path, chunk_bases=8192),
+        batch_size=64, widths=(512,), min_len=1, subsample=100,
+    ))
+    assert sum(int(b.valid.sum()) for b in got) == 100
+
+
+def test_parse_chunks_blank_lines_and_crlf(tmp_path):
+    """Blank separator lines and CRLF endings across chunk boundaries."""
+    path = str(tmp_path / "w.fastq")
+    recs = []
+    for i in range(200):
+        recs.append(f"@r{i}\r\nACGTACGT\r\n+\r\nIIIIIIII\r\n\r\n")
+    (tmp_path / "w.fastq").write_text("".join(recs))
+    whole = native.parse_file(path)
+    assert whole.num_records == 200
+    chunks = list(native.parse_chunks(path, chunk_bases=512))
+    assert sum(c.num_records for c in chunks) == 200
+    np.testing.assert_array_equal(
+        np.concatenate([c.codes for c in chunks]), whole.codes
+    )
