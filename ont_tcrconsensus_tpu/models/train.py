@@ -400,7 +400,20 @@ def _main(argv=None) -> int:
                         help="also write the eval table to this path")
     parser.add_argument("--depth-max", type=int, default=8,
                         help="max subread depth in training examples")
+    parser.add_argument("--cpu", action="store_true",
+                        help="force the CPU backend (the axon TPU plugin "
+                             "overrides JAX_PLATFORMS and a wedged tunnel "
+                             "hangs backend init — same escape hatch as "
+                             "the CLI --cpu / bench BENCH_FORCE_CPU)")
     args = parser.parse_args(argv)
+
+    if args.cpu or os.environ.get("TCR_CONSENSUS_FORCE_CPU"):
+        import jax
+
+        from ont_tcrconsensus_tpu.pipeline.run import enable_compilation_cache
+
+        jax.config.update("jax_platforms", "cpu")
+        enable_compilation_cache()
 
     if args.v3 and args.iid:
         parser.error("--v3 trains on the regime family; --iid is the "

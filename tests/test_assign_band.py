@@ -95,3 +95,47 @@ def test_asymmetric_softclip_budgets_window_minus_strand_umis():
     assert out["is_rev"][valid].tolist() == [False, True, False, True]
     assert (out["d5"][valid] == 0).all(), out["d5"][valid]
     assert (out["d3"][valid] == 0).all(), out["d3"][valid]
+
+
+def test_targeted_pass_agrees_with_fused_pass():
+    """Given the fused pass's own chosen ref as the single candidate, the
+    round-2 targeted pass must reproduce its assignment exactly (ridx,
+    score, blast-id, spans) — the unit-level counterpart of the e2e A/B
+    counts equality."""
+    from ont_tcrconsensus_tpu.io import bucketing
+    from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+
+    lib = simulator.simulate_library(
+        seed=9, num_regions=4, molecules_per_region=(1, 2),
+        reads_per_molecule=(1, 2), sub_rate=0.005, ins_rate=0.002,
+        del_rate=0.002, region_len=(1200, 1400),
+    )
+    res = regions.self_homology_map(lib.reference, cluster_threshold=0.93)
+    panel = A.ReferencePanel.build(dict(lib.reference), res.region_cluster)
+    cfg = RunConfig.from_dict({"reference_file": "x", "fastq_pass_dir": "y"})
+    eng = A.AssignEngine(panel, cfg.umi_fwd, cfg.umi_rev, primers=[])
+
+    # molecule-(+)-oriented records, like round-2 consensus input
+    recs = [
+        fastx.FastxRecord(f"c{i}", "",
+                          simulator.LEFT_FLANK + lib.reference[r]
+                          + simulator.RIGHT_FLANK, None)
+        for i, r in enumerate(lib.reference)
+    ]
+    import numpy as np
+
+    batch = next(bucketing.batch_reads(recs, batch_size=64, with_quals=False))
+    full = eng.run_batch(batch, max_ee_rate=1.0, min_len=1)
+    cand = np.full((len(batch.ids), 1), -1, np.int32)
+    cand[batch.valid, 0] = full["ridx"][batch.valid]
+    import jax
+
+    tgt = jax.device_get(eng.run_batch_targeted_async(batch, cand, min_len=1))
+    v = batch.valid
+    assert (tgt["ridx"][v] == full["ridx"][v]).all()
+    assert (tgt["score"][v] == full["score"][v]).all()
+    assert (np.abs(tgt["blast_id"][v] - full["blast_id"][v]) < 1e-6).all()
+    assert (tgt["ref_start"][v] == full["ref_start"][v]).all()
+    assert (tgt["ref_end"][v] == full["ref_end"][v]).all()
+    assert (tgt["d5"][v] == full["d5"][v]).all()
+    assert (tgt["d3"][v] == full["d3"][v]).all()

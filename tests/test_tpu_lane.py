@@ -151,3 +151,39 @@ for force_pallas in (False, True):
 print("PILEUP_OK")
 """)
     assert "PILEUP_OK" in out
+
+
+@needs_tpu
+def test_targeted_round2_pass_on_tpu():
+    """The round-2 targeted pass (Pallas SW against per-read candidate
+    refs) must agree with the full fused pass's assignment on the real
+    chip — same survivors, same regions, same blast-ids."""
+    out = _run_on_tpu(r"""
+import numpy as np
+from ont_tcrconsensus_tpu.io import bucketing, fastx, simulator
+from ont_tcrconsensus_tpu.cluster import regions as regions_mod
+from ont_tcrconsensus_tpu.pipeline import assign
+from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+lib = simulator.simulate_library(seed=7, num_regions=4, molecules_per_region=(1, 1),
+                                 reads_per_molecule=(1, 1), sub_rate=0.0,
+                                 ins_rate=0.0, del_rate=0.0,
+                                 region_len=(1200, 1400))
+homology = regions_mod.self_homology_map(lib.reference, 0.93)
+panel = assign.ReferencePanel.build(lib.reference, homology.region_cluster)
+cfg = RunConfig.from_dict({"reference_file": "x", "fastq_pass_dir": "y"})
+eng = assign.AssignEngine(panel, cfg.umi_fwd, cfg.umi_rev, primers=[])
+recs = [fastx.FastxRecord(h.split()[0], "", s, None) for h, s, _ in lib.reads]
+batch = next(bucketing.batch_reads(recs, batch_size=64, with_quals=False))
+full = eng.run_batch(batch, max_ee_rate=1.0, min_len=1)
+cand = np.full((len(batch.ids), 1), -1, np.int32)
+cand[batch.valid, 0] = full["ridx"][batch.valid]
+tgt = eng.run_batch_targeted_async(batch, cand, min_len=1)
+import jax
+tgt = jax.device_get(tgt)
+v = batch.valid
+assert (tgt["ridx"][v] == full["ridx"][v]).all()
+assert (np.abs(tgt["blast_id"][v] - full["blast_id"][v]) < 1e-6).all()
+assert (tgt["score"][v] == full["score"][v]).all()
+print("TARGETED_OK")
+""")
+    assert "TARGETED_OK" in out
