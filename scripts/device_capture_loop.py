@@ -82,7 +82,7 @@ def bench_done(path: str) -> bool:
 
 def run_capture(cmd: list[str], timeout: float, out_path: str | None,
                 env_extra: dict | None = None, label: str = "",
-                verify=None) -> bool:
+                verify=None, stderr_path: str | None = None) -> bool:
     env = dict(os.environ)
     env.update(env_extra or {})
     log_line(f"CAPTURE start: {label}")
@@ -96,6 +96,11 @@ def run_capture(cmd: list[str], timeout: float, out_path: str | None,
         log_line(f"CAPTURE timeout after {timeout:.0f}s: {label}")
         return False
     dt = time.time() - t0
+    if stderr_path and proc.stderr:
+        # the bench's stderr carries the per-stage timing table — the
+        # on-chip BENCH_BREAKDOWN evidence VERDICT r3 #2 asks for
+        with open(stderr_path, "w") as fh:
+            fh.write(proc.stderr)
     tail = (proc.stderr or "").strip().splitlines()[-3:]
     if proc.returncode != 0:
         log_line(
@@ -147,6 +152,7 @@ def main() -> None:
             "cmd": [sys.executable, "bench.py"],
             "timeout": 3000, "out": BENCH_OUT,
             "env": {"BENCH_READS": "2000", "BENCH_NO_FALLBACK": "1"},
+            "stderr": BENCH_OUT + ".stderr.log",
         },
         {
             "label": "bench 10k reads", "attempts": 0,
@@ -154,6 +160,7 @@ def main() -> None:
             "cmd": [sys.executable, "bench.py"],
             "timeout": 5400, "out": BENCH_FULL_OUT,
             "env": {"BENCH_NO_FALLBACK": "1"},
+            "stderr": BENCH_FULL_OUT + ".stderr.log",
         },
     ]
 
@@ -190,7 +197,7 @@ def main() -> None:
             stage["cmd"], timeout=stage["timeout"], out_path=stage["out"],
             env_extra=stage["env"],
             label=f"{stage['label']} (attempt {stage['attempts']})",
-            verify=stage["done"],
+            verify=stage["done"], stderr_path=stage.get("stderr"),
         )
         time.sleep(5)  # re-probe promptly between capture steps
 
