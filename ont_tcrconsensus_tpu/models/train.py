@@ -46,6 +46,11 @@ TRAIN_REGIMES: tuple[simulator.OntErrorModel, ...] = (
         motif_sub_boost=(("GA", 2.0), ("CT", 3.5), ("TC", 1.5)),
         transition_frac=0.75,
     ),
+    # v3.1: widen the homopolymer axis upward (held-out hp_shift at 1.6
+    # exposed it as the weakest direction — every subread shrinks the same
+    # run, the one error family voting cannot touch). 1.3 stays short of
+    # the held-out 1.6 so the eval remains out-of-range on that axis.
+    simulator.OntErrorModel(hp_slope=1.3, hp_cap=12.0, del_rate=0.005),
 )
 
 # held out: parameters OUTSIDE the training family's ranges/context sets
@@ -240,6 +245,7 @@ def evaluate_consensus_gain(
     min_confidence: float = 0.9,
     error_model: simulator.OntErrorModel | None = DEFAULT_ERROR_MODEL,
     cluster_batch: int = 16,
+    min_polish_depth: int = 3,
 ) -> dict[int, dict[str, float]]:
     """Precision-at-depth, vote-only vs +RNN, with gate-fire accounting.
 
@@ -259,8 +265,14 @@ def evaluate_consensus_gain(
 
     rng = np.random.default_rng(seed)
     width = _auto_width(template_len)
+    # min_polish_depth=3 (one below the serving default) so the depth-3
+    # row actually MEASURES the gate tradeoff (fixed vs broke) instead of
+    # reporting vote==rnn by construction — that row is the evidence for
+    # whether lowering the serving gate recovers the lane-scale depth-3
+    # undercount (VERDICT r3 weak #3)
     polish = make_pipeline_polisher(params, band_width=band_width,
-                                    min_confidence=min_confidence)
+                                    min_confidence=min_confidence,
+                                    min_polish_depth=min_polish_depth)
     out: dict[int, dict[str, float]] = {}
     for depth in depths:
         vote_ok = rnn_ok = changed = fixed = broke = 0
@@ -336,7 +348,17 @@ def evaluate_regimes(
     """
     if regimes is None:
         regimes = HELDOUT_REGIMES
-    out: dict[str, dict[int, dict[str, float]]] = {}
+    # the gate parameters are part of the result's meaning: the serving
+    # default gates at depth 4, the eval at 3 (to MEASURE that row), and a
+    # v2-vs-v3 depth-3 comparison without this metadata would attribute
+    # the gate delta to the weights (code-review r4)
+    out: dict = {"_meta": {
+        "min_polish_depth": 3, "min_confidence": min_confidence,
+        "n_clusters": n_clusters, "template_len": template_len,
+        "note": "depth rows below the serving min_polish_depth (4) are "
+                "measured with the eval gate (3); serving keeps vote "
+                "consensus there unless the config lowers the gate",
+    }}
     for i, (name, model) in enumerate(sorted(regimes.items())):
         out[name] = evaluate_consensus_gain(
             params, seed=seed + 31 * i, n_clusters=n_clusters,
@@ -376,6 +398,7 @@ def _main(argv=None) -> int:
     import argparse
     import json
     import os
+    import sys
 
     from ont_tcrconsensus_tpu.models.polisher import DEFAULT_WEIGHTS, save_params
 
@@ -405,6 +428,8 @@ def _main(argv=None) -> int:
                              "overrides JAX_PLATFORMS and a wedged tunnel "
                              "hangs backend init — same escape hatch as "
                              "the CLI --cpu / bench BENCH_FORCE_CPU)")
+    parser.add_argument("--resume", action="store_true",
+                        help="warm-start from the existing --out weights")
     args = parser.parse_args(argv)
 
     if args.cpu or os.environ.get("TCR_CONSENSUS_FORCE_CPU"):
@@ -437,9 +462,23 @@ def _main(argv=None) -> int:
 
         params = load_params(args.out)
     else:
+        init = None
+        if args.resume:
+            if not os.path.exists(args.out):
+                parser.error(f"--resume: no weights at {args.out}")
+            from ont_tcrconsensus_tpu.models.polisher import load_params
+
+            init = load_params(args.out)
+            print(f"warm-starting from {args.out}")
+            if args.seed == 0:
+                print("WARNING: --resume with the default --seed replays "
+                      "the IDENTICAL example pool and batch order as the "
+                      "original run — pass a new --seed to continue on "
+                      "fresh data", file=sys.stderr)
         params, losses = train(
             steps=args.steps, batch_size=args.batch_size, seed=args.seed,
             pool_examples=args.pool_examples, template_len=args.template_len,
+            params=init,
             error_model=error_model,
             error_models=TRAIN_REGIMES if args.v3 else None,
             depth_range=(2, args.depth_max),
