@@ -208,11 +208,13 @@ def make_pipeline_polisher(params, band_width: int | None = None,
     kernel in the polish path.
 
     ``min_polish_depth``: clusters with fewer live subreads keep their vote
-    consensus untouched. The precision-at-depth eval
-    (models/weights/polisher_v2_eval.json) shows strong gains at depth >= 4
-    (e.g. 4.8% -> 27% exact at depth 4, 42.8% -> 71.2% at 6) but slight
-    losses at 2-3, where the pileup carries too little evidence for a 0.9
-    gate — medaka's own accuracy collapses in that regime too.
+    consensus untouched. The held-out precision-at-depth eval
+    (models/weights/polisher_v3_eval.json) shows strong gains at depth >= 4
+    in every regime (e.g. in-family 8.4% -> 33% exact at depth 4,
+    43% -> 79% at 6) but a net-NEGATIVE depth-3 tradeoff off-distribution
+    (its _meta records the eval gate) — the pileup carries too little
+    evidence for a 0.9 gate there; medaka's own accuracy collapses in
+    that regime too.
     """
     from ont_tcrconsensus_tpu.ops.consensus import POLISH_BAND_WIDTH
     from ont_tcrconsensus_tpu.ops.encode import PAD_CODE

@@ -245,7 +245,7 @@ def evaluate_consensus_gain(
     min_confidence: float = 0.9,
     error_model: simulator.OntErrorModel | None = DEFAULT_ERROR_MODEL,
     cluster_batch: int = 16,
-    min_polish_depth: int = 3,
+    min_polish_depth: int = 4,
 ) -> dict[int, dict[str, float]]:
     """Precision-at-depth, vote-only vs +RNN, with gate-fire accounting.
 
@@ -265,11 +265,9 @@ def evaluate_consensus_gain(
 
     rng = np.random.default_rng(seed)
     width = _auto_width(template_len)
-    # min_polish_depth=3 (one below the serving default) so the depth-3
-    # row actually MEASURES the gate tradeoff (fixed vs broke) instead of
-    # reporting vote==rnn by construction — that row is the evidence for
-    # whether lowering the serving gate recovers the lane-scale depth-3
-    # undercount (VERDICT r3 weak #3)
+    # default matches the SERVING gate (4) so a plain eval is comparable
+    # with the bundled v2 tables; evaluate_regimes passes 3 explicitly to
+    # MEASURE the depth-3 tradeoff and records it in _meta
     polish = make_pipeline_polisher(params, band_width=band_width,
                                     min_confidence=min_confidence,
                                     min_polish_depth=min_polish_depth)
@@ -338,32 +336,37 @@ def evaluate_regimes(
     template_len: int = 1600,
     depths: tuple[int, ...] = (2, 3, 4, 6, 10),
     min_confidence: float = 0.9,
-) -> dict[str, dict[int, dict[str, float]]]:
+    min_polish_depth: int = 3,
+) -> dict:
     """Per-regime precision-at-depth tables on HELD-OUT error regimes.
 
     The v3 honesty contract (VERDICT r3 #3): the eval can fail — the
     regimes' parameters were never seen in training (hp_shift / ctx_shift)
     or share no structure with it at all (iid). Seeds differ per regime so
-    templates are independent draws too.
+    templates are independent draws too. ``min_polish_depth`` defaults one
+    BELOW the serving gate so the depth-3 rows measure the gate tradeoff;
+    the gate used is recorded in the returned ``_meta``.
     """
     if regimes is None:
         regimes = HELDOUT_REGIMES
-    # the gate parameters are part of the result's meaning: the serving
-    # default gates at depth 4, the eval at 3 (to MEASURE that row), and a
-    # v2-vs-v3 depth-3 comparison without this metadata would attribute
-    # the gate delta to the weights (code-review r4)
+    # the gate parameters are part of the result's meaning: a v2-vs-v3
+    # depth-3 comparison without this metadata would attribute the gate
+    # delta to the weights (code-review r4)
     out: dict = {"_meta": {
-        "min_polish_depth": 3, "min_confidence": min_confidence,
+        "min_polish_depth": min_polish_depth,
+        "min_confidence": min_confidence,
         "n_clusters": n_clusters, "template_len": template_len,
         "note": "depth rows below the serving min_polish_depth (4) are "
-                "measured with the eval gate (3); serving keeps vote "
-                "consensus there unless the config lowers the gate",
+                f"measured with the eval gate ({min_polish_depth}); "
+                "serving keeps vote consensus there unless the config "
+                "lowers the gate",
     }}
     for i, (name, model) in enumerate(sorted(regimes.items())):
         out[name] = evaluate_consensus_gain(
             params, seed=seed + 31 * i, n_clusters=n_clusters,
             template_len=template_len, depths=depths,
             error_model=model, min_confidence=min_confidence,
+            min_polish_depth=min_polish_depth,
         )
     return out
 
@@ -454,7 +457,9 @@ def _main(argv=None) -> int:
 
             args.out = serving_weights_path()
     if args.v3 and args.eval_json is None:
-        args.eval_json = os.path.join(weights_dir, "polisher_v3_eval.json")
+        # derive from --out so a custom-out experiment can never clobber
+        # the bundled evidence file the config/docs cite (code-review r4)
+        args.eval_json = os.path.splitext(args.out)[0] + "_eval.json"
 
     error_model = None if args.iid else DEFAULT_ERROR_MODEL
     if args.eval_only:

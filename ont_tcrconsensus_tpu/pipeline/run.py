@@ -152,7 +152,9 @@ def _run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]
 
         params = polisher_mod.load_default_params()
         if params is not None:
-            polisher = polisher_mod.make_pipeline_polisher(params)
+            polisher = polisher_mod.make_pipeline_polisher(
+                params, min_polish_depth=cfg.min_polish_depth
+            )
         else:
             _log("polish_method=rnn but no bundled weights; using vote consensus only")
     reference = fastx.read_fasta_dict(cfg.reference_file)
