@@ -246,6 +246,7 @@ def evaluate_consensus_gain(
     error_model: simulator.OntErrorModel | None = DEFAULT_ERROR_MODEL,
     cluster_batch: int = 16,
     min_polish_depth: int = 4,
+    polish_iterations: int = 1,
 ) -> dict[int, dict[str, float]]:
     """Precision-at-depth, vote-only vs +RNN, with gate-fire accounting.
 
@@ -270,7 +271,8 @@ def evaluate_consensus_gain(
     # MEASURE the depth-3 tradeoff and records it in _meta
     polish = make_pipeline_polisher(params, band_width=band_width,
                                     min_confidence=min_confidence,
-                                    min_polish_depth=min_polish_depth)
+                                    min_polish_depth=min_polish_depth,
+                                    iterations=polish_iterations)
     out: dict[int, dict[str, float]] = {}
     for depth in depths:
         vote_ok = rnn_ok = changed = fixed = broke = 0

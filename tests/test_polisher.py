@@ -134,3 +134,47 @@ def test_pileup_reuse_path_matches_recompute():
     out_slow, lens_slow = fn(sub, lens, drafts, dlens)
     np.testing.assert_array_equal(lens_fast, lens_slow)
     np.testing.assert_array_equal(out_fast, out_slow)
+
+
+def test_two_pass_polish_contract():
+    """iterations=2 re-piles against the first pass's output: the contract
+    (shapes, PAD tail, empty clusters stay empty) must hold, and with the
+    trained bundled weights a clean cluster must survive both passes
+    unchanged (never-worse under iteration)."""
+    params = polisher.load_default_params() or polisher.init_params(0)
+    rng = np.random.default_rng(4)
+    from ont_tcrconsensus_tpu.io import simulator
+
+    C, S, W = 2, 5, 256
+    sub = np.full((C, S, W), encode.PAD_CODE, np.uint8)
+    lens = np.zeros((C, S), np.int32)
+    drafts = np.full((C, W), encode.PAD_CODE, np.uint8)
+    dlens = np.zeros((C,), np.int32)
+    templates = []
+    for c in range(C):
+        template = simulator._rand_seq(rng, 200)
+        templates.append(template)
+        for i in range(S):
+            enc = encode.encode_seq(template)  # clean subreads
+            sub[c, i, : len(enc)] = enc
+            lens[c, i] = len(enc)
+        t = encode.encode_seq(template)
+        drafts[c, : len(t)] = t
+        dlens[c] = len(t)
+    one = polisher.make_pipeline_polisher(params, iterations=1)
+    two = polisher.make_pipeline_polisher(params, iterations=2)
+    o1, l1 = one(sub, lens, drafts, dlens)
+    o2, l2 = two(sub, lens, drafts, dlens)
+    for c in range(C):
+        t = encode.encode_seq(templates[c])
+        assert l1[c] == len(t) and (o1[c, : l1[c]] == t).all()
+        assert l2[c] == len(t) and (o2[c, : l2[c]] == t).all()
+        assert (o2[c, l2[c]:] == encode.PAD_CODE).all()
+    # empty cluster stays empty through both passes
+    o0, l0 = two(
+        np.full((1, S, W), encode.PAD_CODE, np.uint8),
+        np.zeros((1, S), np.int32),
+        np.full((1, W), encode.PAD_CODE, np.uint8),
+        np.zeros((1,), np.int32),
+    )
+    assert l0[0] == 0
