@@ -39,6 +39,33 @@ import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 
+# --- roofline model (VERDICT r4 #5) ---------------------------------------
+# The banded-DP kernels are VPU work (int32 adds/max/selects on (8,128)
+# vector registers; the MXU never sees them), so the utilization story is
+# cells/s x ops/cell vs the VPU's peak ALU rate, not a FLOP/s fraction.
+#
+# v5e VPU peak: 8x128 lanes x 4 ALUs x ~1.67 GHz core clock ~ 6.8e12
+# int32 ops/s (public architecture numbers; an estimate, labeled as such).
+PEAK_VPU_OPS_V5E = 8 * 128 * 4 * 1.67e9
+# ops/cell for the SW forward inner loop, counted from sw_pallas._row_step:
+# substitution select (~4: cmp+2 masks+select), E chain (open/ext adds,
+# max, select, open-bit ~6), D (~3), tmp maxes/selects (~6), validity
+# masks (~3), and the F shift-doubling cascade: log2(band)=7 passes of
+# shift+sub+cmp+2 selects over the row, ~5*7/1 ~ 18 amortized per cell.
+# Total ~40 integer lane-ops per DP cell.
+SW_OPS_PER_CELL = 40
+# the pileup forward additionally builds/stores the packed direction
+# planes (tdir bit assembly + fjump tracking in the cascade): ~50/cell.
+PILEUP_OPS_PER_CELL = 50
+# MXU peak for the RNN serving matmuls (v5e bf16; fp32 serving runs lower,
+# so this mfu_est is a lower bound on achievable headroom).
+PEAK_MXU_FLOPS_V5E = 197e12
+
+
+def _mfu_cells(gcells: float, ops_per_cell: int) -> float:
+    return round(gcells * 1e9 * ops_per_cell / PEAK_VPU_OPS_V5E, 4)
+
+
 SW_PAIRS = 256
 SW_LEN = 2048
 SW_BAND = 128          # production band (pipeline/assign.py band_width=128)
@@ -108,12 +135,16 @@ def bench_sw(iters: int) -> dict:
         sw_align.align_banded, reads, rlens, refs, tlens, offs,
         band_width=SW_BAND, iters=max(1, iters // 4),
     )
+    gc = cells / dt_p / 1e9
     return {
         "metric": "sw_pallas_gcells_per_sec",
-        "value": round(cells / dt_p / 1e9, 3),
+        "value": round(gc, 3),
         "unit": "Gcell/s",
         "xla_scan_gcells_per_sec": round(cells / dt_x / 1e9, 3),
         "speedup_vs_xla_scan": round(dt_x / dt_p, 2),
+        "mfu_est": _mfu_cells(gc, SW_OPS_PER_CELL),
+        "mfu_model": f"{SW_OPS_PER_CELL} VPU ops/cell vs "
+                     f"{PEAK_VPU_OPS_V5E:.2e} ops/s v5e VPU peak",
         "shapes": {"pairs": SW_PAIRS, "len": SW_LEN, "band": SW_BAND},
         "compile_s": round(comp_p, 1),
         "iter_ms": round(dt_p * 1e3, 2),
@@ -139,10 +170,14 @@ def bench_pileup(iters: int) -> dict:
         pileup_pallas.forward_planes_pallas, reads, rlens, refs, tlens,
         band_width=PILEUP_BAND, iters=iters,
     )
+    gc = cells / dt / 1e9
     return {
         "metric": "pileup_pallas_gcells_per_sec",
-        "value": round(cells / dt / 1e9, 3),
+        "value": round(gc, 3),
         "unit": "Gcell/s",
+        "mfu_est": _mfu_cells(gc, PILEUP_OPS_PER_CELL),
+        "mfu_model": f"{PILEUP_OPS_PER_CELL} VPU ops/cell vs "
+                     f"{PEAK_VPU_OPS_V5E:.2e} ops/s v5e VPU peak",
         "shapes": {"lanes": PILEUP_LANES, "len": PILEUP_LEN, "band": PILEUP_BAND},
         "compile_s": round(comp, 1),
         "iter_ms": round(dt * 1e3, 2),
@@ -159,19 +194,31 @@ def bench_rnn(iters: int) -> dict:
     params = polisher.load_default_params()
     if params is None:
         params = polisher.init_params()
+    fdim = polisher.params_feature_dim(params)  # served weights decide (v4: 25)
     rng = np.random.default_rng(13)
     feats = jnp.asarray(
-        rng.random((RNN_BATCH, RNN_LEN, polisher.FEATURE_DIM), np.float32)
+        rng.random((RNN_BATCH, RNN_LEN, fdim), np.float32)
     )
     fn = jax.jit(polisher.apply_logits)
     comp, dt = _timed(fn, params, feats, iters=iters)
+    # matmul flops per position = 2 * (sum of all 2-D kernel elements);
+    # GRU gate matmuls dominate, so this is the roofline numerator
+    kernels = [
+        np.asarray(x) for x in jax.tree_util.tree_leaves(params)
+        if getattr(x, "ndim", 0) == 2
+    ]
+    flops_per_pos = 2 * int(sum(k.size for k in kernels))
+    pos_per_sec = RNN_BATCH * RNN_LEN / dt
     return {
         "metric": "rnn_polish_clusters_per_sec",
         "value": round(RNN_BATCH / dt, 1),
         "unit": "clusters/s",
-        "positions_per_sec": round(RNN_BATCH * RNN_LEN / dt, 0),
-        "shapes": {"batch": RNN_BATCH, "len": RNN_LEN,
-                   "features": polisher.FEATURE_DIM},
+        "positions_per_sec": round(pos_per_sec, 0),
+        "model_flops_per_pos": flops_per_pos,
+        "mfu_est": round(pos_per_sec * flops_per_pos / PEAK_MXU_FLOPS_V5E, 5),
+        "mfu_model": f"2*params matmul flops/pos vs {PEAK_MXU_FLOPS_V5E:.0e} "
+                     "bf16 v5e MXU peak (fp32 serving: lower-bound est)",
+        "shapes": {"batch": RNN_BATCH, "len": RNN_LEN, "features": fdim},
         "compile_s": round(comp, 1),
         "iter_ms": round(dt * 1e3, 2),
     }
@@ -218,10 +265,16 @@ def bench_fused(iters: int) -> dict:
         return engine.run_batch_async(batch, max_ee_rate=0.03, min_len=500)
 
     comp, dt = _timed(run, iters=iters)
+    sys.path.insert(0, REPO)
+    from bench import NORTH_STAR_READS_PER_SEC_PER_CHIP
+
     return {
         "metric": "fused_assign_reads_per_sec",
         "value": round(n / dt, 1),
         "unit": "reads/s",
+        # round-1 assign alone must beat the WHOLE-pipeline north star
+        # by a comfortable margin for the end-to-end number to reach it
+        "vs_north_star": round(n / dt / NORTH_STAR_READS_PER_SEC_PER_CHIP, 4),
         "shapes": {"reads": n, "padded_len": int(batch.codes.shape[1]),
                    "regions": len(lib.reference)},
         "compile_s": round(comp, 1),

@@ -255,7 +255,23 @@ def _make_batch(recs: list, width: int, batch_size: int, with_quals: bool) -> Re
     # partial batches pad to the pow2 of the real count (see batch_parsed_reads)
     B = min(batch_size, pow2_ceil(n, 64))
     codes = np.full((B, width), encode.PAD_CODE, dtype=np.uint8)
-    quals = np.full((B, width), 93, dtype=np.uint8) if with_quals else None
+    # FASTA records carry no quality: quals must be None, not the filler —
+    # a 93-filled array would sail through the EE filter (10^-9.3) but
+    # poison the v4 polisher's quality channels (code-review r5), and the
+    # None contract is what routes the QUAL_FILL fallback downstream. In a
+    # MIXED stream (concatenated fastq+fasta) the quality-less rows get the
+    # same QUAL_FILL the polisher's fallback and training qual-dropout use
+    # (in-distribution), not 93 — they then face the EE filter at that
+    # mid-range quality like any other read.
+    with_quals = with_quals and any(
+        getattr(rec, "quality", None) for rec in recs
+    )
+    if with_quals:
+        from ont_tcrconsensus_tpu.ops.consensus import QUAL_FILL
+
+        quals = np.full((B, width), QUAL_FILL, dtype=np.uint8)
+    else:
+        quals = None
     lengths = np.zeros((B,), dtype=np.int32)
     valid = np.zeros((B,), dtype=bool)
     ids: list[str] = []

@@ -39,7 +39,16 @@ from ont_tcrconsensus_tpu.parallel.mesh import mesh_data_size
 NUM_CLASSES = 5
 NUM_INS_CLASSES = 5   # none / +A / +C / +G / +T
 TOTAL_LOGITS = NUM_CLASSES + NUM_INS_CLASSES
-FEATURE_DIM = 15  # see ops.consensus.pileup_features
+FEATURE_DIM = 15     # see ops.consensus.pileup_features (v1-v3 weights)
+# single source of truth lives next to the feature builder — the serving
+# dispatch keys on it, so two drifting copies would silently mis-route
+from ont_tcrconsensus_tpu.ops.consensus import FEATURE_DIM_V4  # noqa: E402
+
+
+def params_feature_dim(params: dict) -> int:
+    """The feature dim a params tree was trained for (embed kernel fan-in) —
+    how serving picks the matching feature encoding per weights generation."""
+    return int(np.asarray(params["embed"]["kernel"]).shape[0])
 
 
 class BiGRU(nn.Module):
@@ -69,10 +78,11 @@ class ConsensusPolisher(nn.Module):
         return nn.Dense(TOTAL_LOGITS, name="head")(x)
 
 
-def init_params(rng_seed: int = 0, length: int = 128) -> dict:
+def init_params(rng_seed: int = 0, length: int = 128,
+                feature_dim: int = FEATURE_DIM) -> dict:
     model = ConsensusPolisher()
     rng = jax.random.PRNGKey(rng_seed)
-    return model.init(rng, jnp.zeros((1, length, FEATURE_DIM)))["params"]
+    return model.init(rng, jnp.zeros((1, length, feature_dim)))["params"]
 
 
 def apply_logits(params, feats: jax.Array) -> jax.Array:
@@ -129,14 +139,9 @@ def polish_draft(
     return out, int(kept.size)
 
 
-def _polish_from_pileup(params, base_at, ins_cnt, ins_base, drafts):
-    """(C,S,W) pileup columns -> (pred, conf, depth, ins_pred, ins_conf)."""
-    from ont_tcrconsensus_tpu.ops import consensus as consensus_mod
+def _logits_to_preds(params, feats, base_at):
     from ont_tcrconsensus_tpu.ops import pileup as pileup_mod
 
-    feats = jax.vmap(consensus_mod.pileup_features)(
-        base_at, ins_cnt, ins_base, drafts
-    )
     logits = apply_logits(params, feats)  # (C, W, 10)
     cls, ins = logits[..., :NUM_CLASSES], logits[..., NUM_CLASSES:]
     probs = jax.nn.softmax(cls, axis=-1)
@@ -149,21 +154,56 @@ def _polish_from_pileup(params, base_at, ins_cnt, ins_base, drafts):
     return pred, conf, depth, ins_pred, ins_conf
 
 
+def _polish_from_pileup(params, base_at, ins_cnt, ins_base, drafts):
+    """(C,S,W) pileup columns -> (pred, conf, depth, ins_pred, ins_conf)."""
+    from ont_tcrconsensus_tpu.ops import consensus as consensus_mod
+
+    feats = jax.vmap(consensus_mod.pileup_features)(
+        base_at, ins_cnt, ins_base, drafts
+    )
+    return _logits_to_preds(params, feats, base_at)
+
+
+def _polish_from_pileup_v4(params, base_at, ins_cnt, ins_base, pos_at,
+                           drafts, quals, is_rev):
+    """v4 twin of :func:`_polish_from_pileup`: strand + quality features.
+
+    Extra args: ``pos_at`` (C,S,W) from the traceback, ``quals`` (C,S,W)
+    uint8 phred in canonical orientation, ``is_rev`` (C,S) bool.
+    """
+    from ont_tcrconsensus_tpu.ops import consensus as consensus_mod
+
+    feats = jax.vmap(consensus_mod.pileup_features_v4)(
+        base_at, ins_cnt, ins_base, drafts, pos_at, quals, is_rev
+    )
+    return _logits_to_preds(params, feats, base_at)
+
+
 def _device_polish_batch(params, sub, lens, drafts, dlens, band_width,
-                         mesh=None):
+                         mesh=None, quals=None, is_rev=None):
     """(C,S,W) cluster tile -> (pred (C,W), confidence (C,W), depth (C,W)).
 
     One pileup + one RNN dispatch for the whole tile — the batched medaka
     pass (medaka_polish.py:95-144 analogue, without the per-cluster
     subprocess fan-out the reference schedules around). ``mesh`` shards the
     pileup lanes and the RNN's cluster axis over its ``data`` axis.
+    ``quals``/``is_rev`` non-None routes the v4 feature encoding.
     """
     from ont_tcrconsensus_tpu.ops import pileup as pileup_mod
 
-    base_at, ins_cnt, ins_base, _ = pileup_mod.pileup_columns_batch_auto(
+    base_at, ins_cnt, ins_base, pos_at, _ = pileup_mod.pileup_columns_batch_auto(
         sub, lens, drafts, dlens, band_width=band_width,
         out_len=drafts.shape[1], mesh=mesh,
     )
+    if quals is not None:
+        if mesh is not None:
+            return _sharded_polish_from_pileup_v4(mesh)(
+                params, base_at, ins_cnt, ins_base, pos_at, drafts,
+                quals, is_rev,
+            )
+        return _polish_from_pileup_v4_jit(
+            params, base_at, ins_cnt, ins_base, pos_at, drafts, quals, is_rev
+        )
     if mesh is not None:
         return _sharded_polish_from_pileup(mesh)(
             params, base_at, ins_cnt, ins_base, drafts
@@ -175,6 +215,7 @@ _device_polish_batch_jit = jax.jit(
     _device_polish_batch, static_argnames=("band_width",)
 )
 _polish_from_pileup_jit = jax.jit(_polish_from_pileup)
+_polish_from_pileup_v4_jit = jax.jit(_polish_from_pileup_v4)
 
 
 import functools as _functools  # noqa: E402
@@ -190,6 +231,20 @@ def _sharded_polish_from_pileup(mesh):
     return jax.jit(shard_map(
         _polish_from_pileup, mesh=mesh,
         in_specs=(P(), d, d, d, d), out_specs=(d,) * 5,
+        check_vma=False,
+    ))
+
+
+@_functools.lru_cache(maxsize=None)
+def _sharded_polish_from_pileup_v4(mesh):
+    """v4 twin of :func:`_sharded_polish_from_pileup`."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    d = P("data")
+    return jax.jit(shard_map(
+        _polish_from_pileup_v4, mesh=mesh,
+        in_specs=(P(), d, d, d, d, d, d, d), out_specs=(d,) * 5,
         check_vma=False,
     ))
 
@@ -225,47 +280,76 @@ def make_pipeline_polisher(params, band_width: int | None = None,
     default stays 1. The knob remains for future model generations whose
     confident fixes might compound.
     """
-    from ont_tcrconsensus_tpu.ops.consensus import POLISH_BAND_WIDTH
+    from ont_tcrconsensus_tpu.ops.consensus import POLISH_BAND_WIDTH, QUAL_FILL
     from ont_tcrconsensus_tpu.ops.encode import PAD_CODE
 
     default_band = POLISH_BAND_WIDTH if band_width is None else band_width
+    # the weights generation decides the feature encoding: 25-dim params
+    # serve pileup_features_v4 (strand + qual channels), 15-dim the v1 set
+    wants_v4 = params_feature_dim(params) == FEATURE_DIM_V4
 
     def polish(sub, lens, drafts, dlens, pileup=None, band_width=None,
-               mesh=None):
+               mesh=None, quals=None, strands=None):
         for _ in range(max(int(iterations), 1)):
             drafts, dlens = _polish_once(
                 sub, lens, drafts, dlens, pileup=pileup,
                 band_width=band_width, mesh=mesh,
+                quals=quals, strands=strands,
             )
             pileup = None  # later passes re-pile vs the new draft
         return drafts, dlens
 
     def _polish_once(sub, lens, drafts, dlens, pileup=None, band_width=None,
-                     mesh=None):
+                     mesh=None, quals=None, strands=None):
         """``band_width`` is forwarded by the polish stage so recomputed
         pileups use the SAME band the consensus rounds (and any reused
         pileup) did — two knobs drifting apart would mix feature scales
         within one run. ``mesh`` shards the serving dispatches on the
-        cluster axis (ignored when C doesn't divide its data axis)."""
+        cluster axis (ignored when C doesn't divide its data axis).
+        ``quals`` (C,S,W) phred / ``strands`` (C,S) bool-is-rev feed the
+        v4 feature channels; with v4 weights but no quals (FASTA input)
+        the QUAL_FILL constant stands in — the same fill a fraction of
+        training examples used, so it stays in-distribution."""
         if mesh is not None and np.asarray(drafts).shape[0] % mesh_data_size(mesh):
             mesh = None
+        if wants_v4:
+            if quals is None:
+                quals = np.full(np.asarray(sub).shape, QUAL_FILL, np.uint8)
+            if strands is None:
+                strands = np.zeros(np.asarray(lens).shape, bool)
+        if pileup is not None and wants_v4 and pileup[3] is None:
+            # the consensus stage kept the pileup without its pos_at plane
+            # (keep_pos=False); v4's quality channels need it -> recompute
+            pileup = None
         if pileup is not None:
-            base_at, ins_cnt, ins_base = pileup
-            fn = (_polish_from_pileup_jit if mesh is None
-                  else _sharded_polish_from_pileup(mesh))
-            out = fn(params, base_at, ins_cnt, ins_base, jnp.asarray(drafts))
+            base_at, ins_cnt, ins_base, pos_at = pileup
+            if wants_v4:
+                fn = (_polish_from_pileup_v4_jit if mesh is None
+                      else _sharded_polish_from_pileup_v4(mesh))
+                out = fn(params, base_at, ins_cnt, ins_base, pos_at,
+                         jnp.asarray(drafts), jnp.asarray(quals),
+                         jnp.asarray(strands))
+            else:
+                fn = (_polish_from_pileup_jit if mesh is None
+                      else _sharded_polish_from_pileup(mesh))
+                out = fn(params, base_at, ins_cnt, ins_base,
+                         jnp.asarray(drafts))
         elif mesh is not None:
             out = _device_polish_batch(
                 params, jnp.asarray(sub), jnp.asarray(lens),
                 jnp.asarray(drafts), jnp.asarray(dlens),
                 default_band if band_width is None else band_width,
                 mesh=mesh,
+                quals=jnp.asarray(quals) if wants_v4 else None,
+                is_rev=jnp.asarray(strands) if wants_v4 else None,
             )
         else:
             out = _device_polish_batch_jit(
                 params, jnp.asarray(sub), jnp.asarray(lens),
                 jnp.asarray(drafts), jnp.asarray(dlens),
                 default_band if band_width is None else band_width,
+                quals=jnp.asarray(quals) if wants_v4 else None,
+                is_rev=jnp.asarray(strands) if wants_v4 else None,
             )
         pred, conf, depth, ins_pred, ins_conf = jax.device_get(out)
         drafts = np.asarray(drafts)
@@ -299,6 +383,9 @@ def make_pipeline_polisher(params, band_width: int | None = None,
             out_lens[c] = kept.size
         return out, out_lens
 
+    # the polish stage keys keep_pos (whether the consensus rounds retain
+    # the pos_at plane for the v4 quality channels) off this attribute
+    polish.wants_v4 = wants_v4
     return polish
 
 
@@ -345,14 +432,25 @@ def save_params(params, path: str) -> None:
 def load_params(path: str) -> dict:
     import flax.serialization
 
-    template = init_params()
+    # msgpack_restore needs no shape template, so one loader serves every
+    # weights generation (15-dim v1-v3 and 25-dim v4 alike); the embed
+    # kernel's fan-in then tells serving which feature encoding to build
+    # (params_feature_dim)
     with open(path, "rb") as fh:
-        return flax.serialization.from_bytes(template, fh.read())
+        return flax.serialization.msgpack_restore(fh.read())
 
 
 _WEIGHTS_DIR = os.path.join(os.path.dirname(__file__), "weights")
 DEFAULT_WEIGHTS = os.path.join(_WEIGHTS_DIR, "polisher_v2.msgpack")
-# newest bundled generation wins (v3: held-out-regime training, VERDICT r3 #3)
+# Newest bundled generation wins — but only generations that EARNED it:
+# v4 (strand+qual features, VERDICT r4 #6) measured EQUAL-or-worse to v3
+# under the round-5 eval protocol (same oriented-read simulation for
+# both): depth-4 held-out exactness within noise, depth-3/6 worse (it
+# fires ~3x more, fixed AND broke both up; raising its confidence gate to
+# 0.95 tames breaks but loses the fixes — weights/polisher_v4_eval*.json
+# vs polisher_v3_eval_r5protocol.json). So v4 ships as a recorded
+# experiment, NOT in the serving order; v3 (held-out-regime training,
+# VERDICT r3 #3) remains the served generation.
 _WEIGHT_PREFERENCE = (
     os.path.join(_WEIGHTS_DIR, "polisher_v3.msgpack"),
     DEFAULT_WEIGHTS,
@@ -363,10 +461,21 @@ def serving_weights_path() -> str:
     """The weights file the pipeline actually serves (newest existing
     generation; DEFAULT_WEIGHTS when none exists yet). train._main targets
     this by default so retraining can never silently write a file the
-    pipeline ignores."""
+    pipeline ignores.
+
+    Evidence gate: a v3+ generation is served only once its sibling
+    ``*_eval.json`` exists — the training CLI writes weights first and the
+    held-out eval afterwards, so a mid-training (or mid-session,
+    unevaluated) weights file must not silently flip the whole pipeline's
+    polisher. v2 predates the eval artifact and stays the ungated floor."""
     for path in _WEIGHT_PREFERENCE:
-        if os.path.exists(path):
-            return path
+        if not os.path.exists(path):
+            continue
+        if path != DEFAULT_WEIGHTS:
+            eval_json = os.path.splitext(path)[0] + "_eval.json"
+            if not os.path.exists(eval_json):
+                continue
+        return path
     return DEFAULT_WEIGHTS
 
 

@@ -74,7 +74,7 @@ HELDOUT_REGIMES: dict[str, simulator.OntErrorModel | None] = {
 
 @dataclasses.dataclass
 class ExampleBatch:
-    feats: np.ndarray       # (N, W, F)
+    feats: np.ndarray       # (N, W, F) — F=15 (v1) or 25 (v4 strand+qual)
     labels: np.ndarray      # (N, W) int32: 0-3 base, 4 deletion
     ins_labels: np.ndarray  # (N, W) int32: 0 none, 1-4 insert A/C/G/T after
     mask: np.ndarray        # (N, W) float32: 1 where supervised
@@ -86,12 +86,44 @@ def _auto_width(template_len: int) -> int:
     return 1 << (int(template_len) + 255).bit_length()
 
 
-def _simulate_read(rng, template: str, err, error_model):
+def _simulate_oriented_read(rng, template: str, template_rc: str, err,
+                            error_model):
+    """One subread the way the pipeline actually sees it: sequenced in a
+    random orientation (systematic errors hit the SEQUENCED strand, like
+    simulate_library), then flipped back to canonical (+) with its quals
+    reversed — plus the (quals, is_rev) metadata the v4 features consume.
+
+    Returns (codes uint8, quals uint8 phred, is_rev bool).
+    """
+    is_rev = bool(rng.random() < 0.5)
+    src = template_rc if is_rev else template
     if error_model is not None:
-        s, _ = simulator.mutate_ont(rng, template, error_model)
+        s, q = simulator.mutate_ont(rng, src, error_model)
     else:
-        s, _ = simulator.mutate(rng, template, *err)
-    return encode.encode_seq(s)
+        s, q = simulator.mutate(rng, src, *err)
+    codes = encode.encode_seq(s)
+    quals = (np.frombuffer(q.encode("ascii"), np.uint8).astype(np.int32) - 33)
+    quals = np.clip(quals, 0, 255).astype(np.uint8)
+    if is_rev:
+        codes = encode.revcomp_codes(codes)
+        quals = quals[::-1]
+    return codes, quals, is_rev
+
+
+def sample_depth(rng, depth_range: tuple[int, int],
+                 depth_dist: str = "uniform") -> int:
+    """``lowdepth`` concentrates 70% of examples at depth 2-4 — the regime
+    where the lane-scale counts contract is lost (VERDICT r4 #2: the
+    depth-2/3 molecule loss; medaka itself runs at --depth 2, ref
+    medaka_polish.py:119-134) — with the rest uniform up to the max so
+    deep clusters stay in-distribution."""
+    lo, hi = depth_range
+    low_band = [d for d in (2, 3, 4) if lo <= d <= hi]
+    if depth_dist == "lowdepth" and low_band and hi >= 5:
+        if rng.random() < 0.7:
+            return int(rng.choice(low_band))
+        return int(rng.integers(5, hi + 1))
+    return int(rng.integers(lo, hi + 1))
 
 
 def make_examples(
@@ -106,6 +138,9 @@ def make_examples(
     rounds: int = 4,
     err_weight: float = 50.0,
     error_models: tuple | None = None,
+    features: str = "v1",
+    qual_dropout: float = 0.15,
+    depth_dist: str = "uniform",
 ) -> ExampleBatch:
     """Build supervised examples from simulated low-depth clusters.
 
@@ -126,6 +161,13 @@ def make_examples(
       the mass, so an unweighted model learns to copy the draft with high
       confidence and the serving gate never fires. ``err_weight`` rebalances
       exactly those positions.
+
+    v4 additions: subreads are sequenced in random orientation (systematic
+    errors hit the sequenced strand) and ``features="v4"`` builds the
+    25-channel strand+quality encoding; ``qual_dropout`` replaces a
+    fraction of examples' quals with the QUAL_FILL constant so serving on
+    FASTA input (no quals) stays in-distribution; ``depth_dist="lowdepth"``
+    concentrates training at depth 2-4 (see :func:`sample_depth`).
     """
     if width is None:
         width = _auto_width(template_len)
@@ -133,36 +175,52 @@ def make_examples(
     feats_l, labels_l, ins_l, mask_l = [], [], [], []
     for n in range(n_examples):
         template = simulator._rand_seq(rng, template_len)
-        depth = int(rng.integers(depth_range[0], depth_range[1] + 1))
+        template_rc = simulator.revcomp(template)
+        depth = sample_depth(rng, depth_range, depth_dist)
         # v3 domain randomization: cycle the regime per example
         em = error_models[n % len(error_models)] if error_models else error_model
-        reads = [
-            _simulate_read(rng, template, err, em)
-            for _ in range(depth)
-        ]
         codes = np.full((depth, width), encode.PAD_CODE, np.uint8)
         lens = np.zeros(depth, np.int32)
-        for i, r in enumerate(reads):
+        quals = np.zeros((depth, width), np.uint8)
+        strands = np.zeros(depth, bool)
+        for i in range(depth):
+            r, q, is_rev = _simulate_oriented_read(
+                rng, template, template_rc, err, em
+            )
             codes[i, : len(r)] = r
+            quals[i, : len(q)] = q
             lens[i] = len(r)
+            strands[i] = is_rev
+        if rng.random() < qual_dropout:
+            # the no-quals serving regime: constant fill on the real rows
+            pos = np.arange(width)[None, :]
+            quals = np.where(
+                pos < lens[:, None], consensus.QUAL_FILL, 0
+            ).astype(np.uint8)
         draft, draft_len = consensus.consensus_cluster(
             codes, lens, rounds=rounds, band_width=band_width, pad_to=width
         )
         if draft_len == 0:
             continue
-        base_at, ins_cnt, ins_base, _ = pileup.pileup_columns(
+        base_at, ins_cnt, ins_base, pos_at, _ = pileup.pileup_columns(
             codes, lens, jnp.asarray(draft), jnp.int32(draft_len),
             np.zeros(depth, np.int32), band_width=band_width, out_len=width,
         )
-        feats = np.asarray(
-            consensus.pileup_features(base_at, ins_cnt, ins_base, draft)
-        )
+        if features == "v4":
+            feats = np.asarray(consensus.pileup_features_v4(
+                base_at, ins_cnt, ins_base, draft, pos_at,
+                jnp.asarray(quals), jnp.asarray(strands),
+            ))
+        else:
+            feats = np.asarray(
+                consensus.pileup_features(base_at, ins_cnt, ins_base, draft)
+            )
 
         # label by aligning the truth to the draft
         truth = encode.encode_seq(template)
         tcodes = np.full((1, width), encode.PAD_CODE, np.uint8)
         tcodes[0, : len(truth)] = truth
-        t_base, t_ins_cnt, t_ins_base, _ = pileup.pileup_columns(
+        t_base, t_ins_cnt, t_ins_base, _, _ = pileup.pileup_columns(
             tcodes, np.array([len(truth)], np.int32),
             jnp.asarray(draft), jnp.int32(draft_len),
             np.zeros(1, np.int32), band_width=band_width, out_len=width,
@@ -204,15 +262,19 @@ def train(
     error_model: simulator.OntErrorModel | None = DEFAULT_ERROR_MODEL,
     error_models: tuple | None = None,
     depth_range: tuple[int, int] = (2, 8),
+    features: str = "v1",
+    depth_dist: str = "uniform",
 ) -> tuple[dict, list[float]]:
     """Train the polisher; returns (params, loss trace)."""
     pool = make_examples(
         seed, pool_examples, template_len=template_len,
         error_model=error_model, error_models=error_models,
-        depth_range=depth_range,
+        depth_range=depth_range, features=features, depth_dist=depth_dist,
     )
     if params is None:
-        params = polisher.init_params(seed)
+        params = polisher.init_params(
+            seed, feature_dim=pool.feats.shape[-1]
+        )
     optimizer = optax.adam(lr)
     opt_state = optimizer.init(params)
     step_fn = polisher.make_train_step(optimizer)
@@ -283,18 +345,26 @@ def evaluate_consensus_gain(
             truths = []
             codes = np.full((cb, depth, width), encode.PAD_CODE, np.uint8)
             lens = np.zeros((cb, depth), np.int32)
+            quals = np.zeros((cb, depth, width), np.uint8)
+            strands = np.zeros((cb, depth), bool)
             for c in range(cb):
                 template = simulator._rand_seq(rng, template_len)
+                template_rc = simulator.revcomp(template)
                 truths.append(encode.encode_seq(template))
                 for i in range(depth):
-                    r = _simulate_read(rng, template, err, error_model)
+                    r, q, is_rev = _simulate_oriented_read(
+                        rng, template, template_rc, err, error_model
+                    )
                     codes[c, i, : len(r)] = r
+                    quals[c, i, : len(q)] = q
                     lens[c, i] = len(r)
+                    strands[c, i] = is_rev
             drafts, dlens = consensus.consensus_clusters_batch(
                 codes, lens, rounds=4, band_width=band_width
             )
             drafts, dlens = np.asarray(drafts), np.asarray(dlens)
-            pol, plens = polish(codes, lens, drafts, dlens)
+            pol, plens = polish(codes, lens, drafts, dlens,
+                                quals=quals, strands=strands)
             for c in range(cb):
                 truth = truths[c]
                 v_ok = dlens[c] == len(truth) and (
@@ -375,15 +445,19 @@ def evaluate_regimes(
 
 def evaluate_accuracy(params, seed: int = 99, n_examples: int = 32) -> dict[str, float]:
     """Per-position accuracy of the polisher vs the raw draft on held-out data."""
-    ex = make_examples(seed, n_examples)
+    fdim = polisher.params_feature_dim(params)
+    ex = make_examples(
+        seed, n_examples,
+        features="v4" if fdim == polisher.FEATURE_DIM_V4 else "v1",
+    )
     logits = np.asarray(polisher.apply_logits(params, jnp.asarray(ex.feats)))
     pred = logits[..., : polisher.NUM_CLASSES].argmax(axis=-1)
     m = ex.mask > 0
     model_acc = float((pred[m] == ex.labels[m]).mean())
-    # baseline: the draft itself (class = draft base, never deletion);
-    # feats[..., 11:15] is the draft one-hot
-    draft_base = ex.feats[..., 11:15].argmax(axis=-1)
-    draft_is_base = ex.feats[..., 11:15].sum(axis=-1) > 0
+    # baseline: the draft itself (class = draft base, never deletion); the
+    # draft one-hot is the LAST 4 feature channels in both encodings
+    draft_base = ex.feats[..., -4:].argmax(axis=-1)
+    draft_is_base = ex.feats[..., -4:].sum(axis=-1) > 0
     base_acc = float(
         ((draft_base[m] == ex.labels[m]) & draft_is_base[m]).mean()
     )
@@ -424,6 +498,11 @@ def _main(argv=None) -> int:
                         help="v3 flow: train on the randomized regime "
                              "family, evaluate on held-out regimes, write "
                              "polisher_v3.msgpack + polisher_v3_eval.json")
+    parser.add_argument("--v4", action="store_true",
+                        help="v4 flow: the v3 regime family PLUS the "
+                             "25-channel strand+quality features and a "
+                             "low-depth-dominant (2-4) example mix; writes "
+                             "polisher_v4.msgpack + polisher_v4_eval.json")
     parser.add_argument("--eval-json", default=None,
                         help="also write the eval table to this path")
     parser.add_argument("--depth-max", type=int, default=8,
@@ -445,12 +524,16 @@ def _main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
         enable_compilation_cache()
 
-    if args.v3 and args.iid:
-        parser.error("--v3 trains on the regime family; --iid is the "
+    if (args.v3 or args.v4) and args.iid:
+        parser.error("--v3/--v4 train on the regime family; --iid is the "
                      "single-regime ablation — pick one")
+    if args.v3 and args.v4:
+        parser.error("pick ONE of --v3 / --v4")
     weights_dir = os.path.dirname(DEFAULT_WEIGHTS)
     if args.out is None:
-        if args.v3:
+        if args.v4:
+            args.out = os.path.join(weights_dir, "polisher_v4.msgpack")
+        elif args.v3:
             args.out = os.path.join(weights_dir, "polisher_v3.msgpack")
         else:
             # target what the pipeline SERVES so a default retrain can
@@ -458,7 +541,20 @@ def _main(argv=None) -> int:
             from ont_tcrconsensus_tpu.models.polisher import serving_weights_path
 
             args.out = serving_weights_path()
-    if args.v3 and args.eval_json is None:
+            base = os.path.basename(args.out)
+            if base not in ("polisher_v2.msgpack",):
+                # ADVICE r4: a plain retrain resolving to a v3/v4 file
+                # would overwrite regime-family weights with single-regime
+                # ones AND leave the sibling _eval.json describing weights
+                # that no longer exist — refuse instead of diverging
+                parser.error(
+                    f"default --out resolves to the served weights "
+                    f"{base}, which were trained with the "
+                    f"{'--v4' if 'v4' in base else '--v3'} flow; pass "
+                    f"that flag to retrain them, or an explicit --out "
+                    f"for a single-regime experiment"
+                )
+    if (args.v3 or args.v4) and args.eval_json is None:
         # derive from --out so a custom-out experiment can never clobber
         # the bundled evidence file the config/docs cite (code-review r4)
         args.eval_json = os.path.splitext(args.out)[0] + "_eval.json"
@@ -487,12 +583,14 @@ def _main(argv=None) -> int:
             pool_examples=args.pool_examples, template_len=args.template_len,
             params=init,
             error_model=error_model,
-            error_models=TRAIN_REGIMES if args.v3 else None,
+            error_models=TRAIN_REGIMES if (args.v3 or args.v4) else None,
             depth_range=(2, args.depth_max),
+            features="v4" if args.v4 else "v1",
+            depth_dist="lowdepth" if args.v4 else "uniform",
         )
         save_params(params, args.out)
         print(f"saved {args.out} (final loss {losses[-1]:.4f})")
-    if args.v3:
+    if args.v3 or args.v4:
         gain = evaluate_regimes(
             params, template_len=args.template_len,
             n_clusters=args.eval_clusters,

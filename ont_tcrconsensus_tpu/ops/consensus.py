@@ -164,7 +164,7 @@ def consensus_cluster(
 
     offsets = np.zeros((S,), np.int32)
     for _ in range(rounds):
-        base_at, ins_cnt, ins_base, spans = pileup.pileup_columns(
+        base_at, ins_cnt, ins_base, _, spans = pileup.pileup_columns(
             subreads, subread_lens, jnp.asarray(draft), jnp.asarray(draft_len),
             offsets, band_width=band_width, out_len=width,
         )
@@ -205,7 +205,8 @@ def _sharded_vote_fn(mesh):
 
 
 @functools.lru_cache(maxsize=None)
-def _fused_round_fn(band_width: int, out_len: int, S: int, mesh):
+def _fused_round_fn(band_width: int, out_len: int, S: int, mesh,
+                    with_pos: bool = True):
     """ONE device dispatch per consensus round: banded forward + scan-log
     traceback + column vote fused into a single jitted program.
 
@@ -213,8 +214,9 @@ def _fused_round_fn(band_width: int, out_len: int, S: int, mesh):
     hundreds of round trips per library over a tunneled TPU. Fusing also
     lets XLA keep the direction planes on device between forward and
     traceback. Returns (new_drafts (C, 2W), new_lens, spans (C,S,4),
-    base_at, ins_cnt, ins_base) — the pileup columns stay on device for
-    the polisher's reuse path.
+    base_at, ins_cnt, ins_base, pos_at) — the pileup columns stay on
+    device for the polisher's reuse path (pos_at feeds its v4 quality
+    channels; XLA DCEs it in rounds where the caller drops it).
 
     Inputs are FLAT lanes (C folded into the leading axis; ``S`` static),
     so the compiled-program count scales with (band, width, S) — the
@@ -231,7 +233,7 @@ def _fused_round_fn(band_width: int, out_len: int, S: int, mesh):
             reads, rlens.astype(jnp.int32), refs, reflens,
             band_width=band_width,
         )
-        base_at, ins_cnt, ins_base, spans = _traceback_batch(
+        base_at, ins_cnt, ins_base, pos_at, spans = _traceback_batch(
             best, planes, reads, band_width, out_len
         )
         base_at = base_at.reshape(C, S, out_len)
@@ -240,7 +242,14 @@ def _fused_round_fn(band_width: int, out_len: int, S: int, mesh):
         new_drafts, new_lens = jax.vmap(vote_columns)(
             base_at, ins_cnt, ins_base, drafts, dlens
         )
-        return new_drafts, new_lens, spans.reshape(C, S, 4), base_at, ins_cnt, ins_base
+        out = (new_drafts, new_lens, spans.reshape(C, S, 4),
+               base_at, ins_cnt, ins_base)
+        if with_pos:
+            # pos_at feeds only the v4 feature encoding; dropping it here
+            # lets XLA DCE its scatter AND spares the (C,S,W) int32 HBM
+            # buffer on the v1/v3 serving path (code-review r5)
+            out = out + (pos_at.reshape(C, S, out_len),)
+        return out
 
     if mesh is None:
         return jax.jit(round_impl)
@@ -249,10 +258,11 @@ def _fused_round_fn(band_width: int, out_len: int, S: int, mesh):
 
     d = P("data")
     d2, d3 = P("data", None), P("data", None, None)
+    n_out = 7 if with_pos else 6
     return jax.jit(shard_map(
         round_impl, mesh=mesh,
         in_specs=(d2, d, d2, d),
-        out_specs=(d2, d, d3, d3, d3, d3),
+        out_specs=(d2, d) + (d3,) * (n_out - 2),
         check_vma=False,
     ))
 
@@ -311,6 +321,7 @@ def consensus_clusters_batch(
     rounds: int = 4,
     band_width: int = POLISH_BAND_WIDTH,
     keep_final_pileup: bool = False,
+    keep_pos: bool = True,
     mesh=None,
 ) -> tuple[np.ndarray, np.ndarray] | tuple[np.ndarray, np.ndarray, tuple | None]:
     """Batched :func:`consensus_cluster` over C same-shape clusters.
@@ -319,7 +330,10 @@ def consensus_clusters_batch(
       subreads: (C, S, W) uint8 dense codes (0-length rows = padding);
       subread_lens: (C, S).
       keep_final_pileup: also return the last round's device pileup
-        ``(base_at, ins_cnt, ins_base)`` when it was computed against the FINAL drafts
+        ``(base_at, ins_cnt, ins_base, pos_at)`` when it was computed against the FINAL drafts.
+        ``keep_pos=False`` returns ``pos_at=None`` and skips its scatter +
+        (C,S,W) int32 buffer entirely — the v1/v3 polisher features never
+        read it, only the v4 quality channels do (code-review r5)
         (i.e. the loop exited via convergence, so the pre-vote drafts equal
         the returned ones) — the RNN polisher consumes exactly that pileup
         and can skip recomputing it. ``None`` when the loop hit the rounds
@@ -375,8 +389,9 @@ def consensus_clusters_batch(
     active = np.where(nreal > 0)[0]
     pile_parts: list[tuple[np.ndarray, tuple]] = []
     d_sub_full = d_lens_full = None
+    with_pos = keep_final_pileup and keep_pos
     if use_fused:
-        round_fn = _fused_round_fn(band_width, W, S, mesh)
+        round_fn = _fused_round_fn(band_width, W, S, mesh, with_pos)
 
     for _ in range(rounds):
         if len(active) == 0:
@@ -418,11 +433,13 @@ def consensus_clusters_batch(
             else:
                 d_sub = jnp.asarray(sub_a).reshape(Ca * S, W)
                 d_lens = jnp.asarray(lens_a).reshape(Ca * S).astype(jnp.int32)
-            new_drafts, new_lens, spans, base_at, ins_cnt, ins_base = round_fn(
+            (new_drafts, new_lens, spans,
+             base_at, ins_cnt, ins_base, *maybe_pos) = round_fn(
                 d_sub, d_lens, jnp.asarray(drafts_a), jnp.asarray(dlens_a)
             )
+            pos_at = maybe_pos[0] if maybe_pos else None
         else:
-            base_at, ins_cnt, ins_base, spans = pileup.pileup_columns_batch_auto(
+            base_at, ins_cnt, ins_base, pos_at, spans = pileup.pileup_columns_batch_auto(
                 sub_a, lens_a, jnp.asarray(drafts_a), jnp.asarray(dlens_a),
                 band_width=band_width, out_len=W, mesh=mesh,
             )
@@ -456,10 +473,12 @@ def consensus_clusters_batch(
         newly_stable = stable & in_active
         if keep_final_pileup and newly_stable.any():
             local = jnp.asarray(np.where(newly_stable)[0])
+            planes = (base_at, ins_cnt, ins_base) + (
+                (pos_at,) if with_pos and pos_at is not None else ()
+            )
             pile_parts.append((
                 idx[:n_act][newly_stable],
-                tuple(jnp.take(p, local, axis=0)
-                      for p in (base_at, ins_cnt, ins_base)),
+                tuple(jnp.take(p, local, axis=0) for p in planes),
             ))
         active = idx[:n_act][in_active & ~stable]
 
@@ -474,13 +493,16 @@ def consensus_clusters_batch(
         buf_ba = jnp.full((C, S, W), pileup.UNCOVERED, jnp.uint8)
         buf_ic = jnp.zeros((C, S, W), jnp.int32)
         buf_ib = jnp.zeros((C, S, W), jnp.uint8)
+        buf_pa = jnp.full((C, S, W), -1, jnp.int32) if with_pos else None
         while pile_parts:  # pop-consume so each part frees after scatter
-            idxs, (pba, pic, pib) = pile_parts.pop(0)
+            idxs, (pba, pic, pib, *ppa) = pile_parts.pop(0)
             d_idx = jnp.asarray(idxs)
             buf_ba = buf_ba.at[d_idx].set(pba.astype(buf_ba.dtype))
             buf_ic = buf_ic.at[d_idx].set(pic.astype(buf_ic.dtype))
             buf_ib = buf_ib.at[d_idx].set(pib.astype(buf_ib.dtype))
-        final_pileup = (buf_ba, buf_ic, buf_ib)
+            if with_pos and ppa:
+                buf_pa = buf_pa.at[d_idx].set(ppa[0].astype(buf_pa.dtype))
+        final_pileup = (buf_ba, buf_ic, buf_ib, buf_pa)
     return drafts, dlens, final_pileup
 
 
@@ -515,4 +537,81 @@ def pileup_features(
     return jnp.concatenate(
         [jnp.log1p(counts), jnp.log1p(ins_counts), jnp.log1p(ins),
          jnp.log1p(depth), draft_oh], axis=1
+    )
+
+
+FEATURE_DIM_V4 = 25
+# phred fill when the input carried no qualities (FASTA): mid-range for the
+# regimes the model trains on; training applies the same fill on a fraction
+# of examples (qual dropout) so serving without quals stays in-distribution
+QUAL_FILL = 18
+
+
+@functools.partial(jax.jit, static_argnames=())
+def pileup_features_v4(
+    base_at: jax.Array, ins_cnt: jax.Array, ins_base: jax.Array,
+    draft: jax.Array, pos_at: jax.Array, quals: jax.Array,
+    is_rev: jax.Array,
+) -> jax.Array:
+    """(S, Ld) columns -> (Ld, 25) float32 polisher-v4 features.
+
+    The medaka capability gap the 15-channel encoding left open (VERDICT r4
+    #6): medaka's counts matrix is STRAND-STRATIFIED and its pileups carry
+    base qualities; ours collapsed strands and ignored quals. Channels:
+
+    - 0-4   A/C/G/T/del counts from forward-strand subreads (log1p);
+    - 5-9   the same from reverse-strand subreads (log1p) — a systematic
+            context error hits only one strand (the simulator mutates the
+            sequenced strand), so a strand-split disagreement is the
+            polisher's strongest correction signal;
+    - 10-13 quality-weighted base counts: sum of phred/10 over the subreads
+            voting each base (log1p) — a high-qual minority can outweigh a
+            low-qual majority, exactly medaka's weighted-counts trick;
+    - 14    mean phred/10 over the base votes at this column;
+    - 15-18 per-base inserted-base counts (log1p), as v1;
+    - 19    insertion-reporting count (log1p); 20 depth (log1p);
+    - 21-24 draft base one-hot.
+
+    Args beyond the v1 set: ``pos_at`` (S, Ld) int32 read position of each
+    base vote (-1 for deletion/uncovered; from the traceback), ``quals``
+    (S, Lr) uint8 phred (ALREADY in canonical orientation: callers reverse
+    the qual string of '-' reads alongside the revcomp), ``is_rev`` (S,)
+    bool sequenced-strand flags.
+    """
+    S, Ld = base_at.shape
+    covered = base_at != pileup.UNCOVERED
+    rev = is_rev.astype(bool)[:, None]  # (S, 1)
+    counts_f = jnp.stack(
+        [jnp.sum((base_at == code) & ~rev, axis=0) for code in range(5)],
+        axis=1,
+    ).astype(jnp.float32)  # (Ld, 5)
+    counts_r = jnp.stack(
+        [jnp.sum((base_at == code) & rev, axis=0) for code in range(5)],
+        axis=1,
+    ).astype(jnp.float32)  # (Ld, 5)
+
+    has_base = base_at < 4  # a real base vote (not deletion/uncovered)
+    q = jnp.take_along_axis(
+        quals, jnp.clip(pos_at, 0, quals.shape[1] - 1).astype(jnp.int32),
+        axis=1,
+    ).astype(jnp.float32) / 10.0
+    q = jnp.where(has_base & (pos_at >= 0), q, 0.0)  # (S, Ld)
+    qw = jnp.stack(
+        [jnp.sum(q * (base_at == code), axis=0) for code in range(4)], axis=1
+    )  # (Ld, 4)
+    n_base = jnp.sum(has_base & (pos_at >= 0), axis=0).astype(jnp.float32)
+    q_mean = (jnp.sum(q, axis=0) / jnp.maximum(n_base, 1.0))[:, None]
+
+    has_ins = (ins_cnt > 0) & covered
+    ins_counts = jnp.stack(
+        [jnp.sum(has_ins & (ins_base == code), axis=0) for code in range(4)],
+        axis=1,
+    ).astype(jnp.float32)
+    ins = jnp.sum(has_ins, axis=0).astype(jnp.float32)[:, None]
+    depth = jnp.sum(covered, axis=0).astype(jnp.float32)[:, None]
+    draft_oh = jax.nn.one_hot(jnp.minimum(draft[:Ld], 4), 4, dtype=jnp.float32)
+    return jnp.concatenate(
+        [jnp.log1p(counts_f), jnp.log1p(counts_r), jnp.log1p(qw), q_mean,
+         jnp.log1p(ins_counts), jnp.log1p(ins), jnp.log1p(depth), draft_oh],
+        axis=1,
     )

@@ -150,6 +150,7 @@ def _traceback_one(best, tdir, fjump, read, diag_offset, band_width, out_len):
     base_at0 = jnp.full((out_len,), UNCOVERED, jnp.uint8)
     ins_cnt0 = jnp.zeros((out_len,), jnp.int32)
     ins_base0 = jnp.zeros((out_len,), jnp.uint8)
+    pos_at0 = jnp.full((out_len,), -1, jnp.int32)
 
     score, i0, b0 = best[0], best[1], best[2]
     jend = i0 + off - c + b0
@@ -159,13 +160,17 @@ def _traceback_one(best, tdir, fjump, read, diag_offset, band_width, out_len):
     MODE_H, MODE_E, MODE_TMP = jnp.int32(0), jnp.int32(1), jnp.int32(2)
 
     # state: (i, b, mode, pending_del, done, base_at, ins_cnt, ins_base,
-    #         read_start, ref_start) — the *_start fields track the smallest
-    # read / draft position the path consumed (emitted) so far.
+    #         pos_at, read_start, ref_start) — the *_start fields track the
+    # smallest read / draft position the path consumed (emitted) so far;
+    # pos_at records WHICH read position produced each base vote (-1 for
+    # deletions / uncovered), the index the polisher's quality channels
+    # gather through.
     def cond(state):
         return ~state[4]
 
     def step(state):
-        i, b, mode, pending, done, base_at, ins_cnt, ins_base, rstart, fstart = state
+        (i, b, mode, pending, done, base_at, ins_cnt, ins_base, pos_at,
+         rstart, fstart) = state
         jrow = i + off - c + b
         jc = jnp.clip(jrow, 0, out_len - 1)
         j_ok = (jrow >= 0) & (jrow < out_len)
@@ -189,6 +194,7 @@ def _traceback_one(best, tdir, fjump, read, diag_offset, band_width, out_len):
         is_fresh = ~do_del & (choice == _FRESH)
 
         base_at = jnp.where(is_diag & j_ok & rb_known, base_at.at[jc].set(rb), base_at)
+        pos_at = jnp.where(is_diag & j_ok & rb_known, pos_at.at[jc].set(i), pos_at)
         ins_cnt = jnp.where(is_egap & j_ok & rb_known, ins_cnt.at[jc].add(1), ins_cnt)
         ins_base = jnp.where(is_egap & j_ok & rb_known, ins_base.at[jc].set(rb), ins_base)
 
@@ -205,17 +211,18 @@ def _traceback_one(best, tdir, fjump, read, diag_offset, band_width, out_len):
         ndone = is_fresh | diag_stop | (ni < 0) | (nb < 0) | (nb >= W)
         rstart = jnp.where(is_diag | is_egap, i, rstart)
         fstart = jnp.where(is_diag | do_del, jrow, fstart)
-        return (ni, nb, nmode, new_pending, ndone, base_at, ins_cnt, ins_base, rstart, fstart)
+        return (ni, nb, nmode, new_pending, ndone, base_at, ins_cnt, ins_base,
+                pos_at, rstart, fstart)
 
     init = (
         i0, b0, MODE_H, jnp.int32(0),
         (score <= 0) | (i0 < 0),
-        base_at0, ins_cnt0, ins_base0,
+        base_at0, ins_cnt0, ins_base0, pos_at0,
         i0 + 1, jend + 1,
     )
     out = jax.lax.while_loop(cond, step, init)
-    span = jnp.stack([out[8], i0 + 1, out[9], jend + 1])  # read/ref start,end
-    return out[5], out[6], out[7], span
+    span = jnp.stack([out[9], i0 + 1, out[10], jend + 1])  # read/ref start,end
+    return out[5], out[6], out[7], out[8], span
 
 
 @functools.partial(jax.jit, static_argnames=("band_width", "out_len"))
@@ -240,6 +247,9 @@ def pileup_columns(
       base_at: (S, out_len) uint8 — 0-3 base, 4 deletion, 5 uncovered;
       ins_cnt: (S, out_len) int32 — insertion run length after position j;
       ins_base: (S, out_len) uint8 — first base of that insertion run;
+      pos_at: (S, out_len) int32 — read position that cast each base vote
+        (-1 where no base: deletion/uncovered) — the index the polisher's
+        base-quality channels gather through;
       spans: (S, 4) int32 — [read_start, read_end, ref_start, ref_end)
         of each subread's local alignment (ends exclusive), for end-extension
         voting in the consensus driver.
@@ -394,6 +404,8 @@ def _traceback_batch(best, planes, reads, band_width: int, out_len: int):
     set_hit = (op_t == OP_DEL) | ((op_t == OP_DIAG) & rb_known)
     set_j = jnp.where(set_hit, jc_t, out_len)
     set_v = jnp.where(op_t == OP_DEL, jnp.uint8(DELETION), rb_t.astype(jnp.uint8))
+    diag_hit = (op_t == OP_DIAG) & rb_known
+    diag_j = jnp.where(diag_hit, jc_t, out_len)
     ins_hit = (op_t == OP_INS) & rb_known
     ins_j = jnp.where(ins_hit, jc_t, out_len)
     ts = jnp.arange(T, dtype=jnp.int32)[:, None]
@@ -402,13 +414,15 @@ def _traceback_batch(best, planes, reads, band_width: int, out_len: int):
     lanes_T = jnp.broadcast_to(lane[None, :], (T, N))
     base_at = jnp.full((N, out_len), UNCOVERED, jnp.uint8)
     base_at = base_at.at[lanes_T, set_j].set(set_v, mode="drop")
+    pos_at = jnp.full((N, out_len), -1, jnp.int32)
+    pos_at = pos_at.at[lanes_T, diag_j].set(i_t.astype(jnp.int32), mode="drop")
     ins_cnt = jnp.zeros((N, out_len), jnp.int32)
     ins_cnt = ins_cnt.at[lanes_T, ins_j].add(1, mode="drop")
     pk0 = jnp.full((N, out_len), -1, jnp.int32)
     pk = pk0.at[lanes_T, ins_j].max(ins_pk, mode="drop")
     ins_base = jnp.where(pk >= 0, (pk % 4).astype(jnp.uint8), jnp.uint8(0))
     spans = jnp.stack([rstart, i0 + 1, fstart, jend + 1], axis=1)
-    return base_at, ins_cnt, ins_base, spans
+    return base_at, ins_cnt, ins_base, pos_at, spans
 
 
 @functools.lru_cache(maxsize=None)
@@ -433,7 +447,7 @@ def _sharded_pileup_fn(mesh, band_width: int, out_len: int):
     d1, d2 = P("data"), P("data", None)
     return jax.jit(shard_map(
         base, mesh=mesh, in_specs=(d2, d1, d2, d1),
-        out_specs=(d2, d2, d2, d2),
+        out_specs=(d2, d2, d2, d2, d2),
         check_vma=False,
     ))
 
@@ -491,24 +505,25 @@ def pileup_columns_batch_auto(
             interpret=on_cpu,
         )
         planes = tdir.astype(jnp.uint16) | (fjump.astype(jnp.uint16) << 4)
-        base_at, ins_cnt, ins_base, spans = _traceback_batch(
+        base_at, ins_cnt, ins_base, pos_at, spans = _traceback_batch(
             best, planes, reads, band_width, out_len
         )
     elif use_mesh:
-        base_at, ins_cnt, ins_base, spans = _sharded_pileup_fn(
+        base_at, ins_cnt, ins_base, pos_at, spans = _sharded_pileup_fn(
             mesh, band_width, out_len
         )(reads, rlens.astype(jnp.int32), refs, reflens)
     else:
         best, planes = _forward_batch(
             reads, rlens, refs, reflens, band_width=band_width
         )
-        base_at, ins_cnt, ins_base, spans = _traceback_batch(
+        base_at, ins_cnt, ins_base, pos_at, spans = _traceback_batch(
             best, planes, reads, band_width, out_len
         )
     return (
         base_at.reshape(C, S, out_len),
         ins_cnt.reshape(C, S, out_len),
         ins_base.reshape(C, S, out_len),
+        pos_at.reshape(C, S, out_len),
         spans.reshape(C, S, 4),
     )
 
@@ -528,7 +543,8 @@ def pileup_columns_batch(
       subreads: (C, S, L); subread_lens: (C, S); drafts: (C, Ld);
       draft_lens: (C,). Diag offsets are 0 (same-molecule subreads).
 
-    Returns (base_at (C,S,out_len), ins_cnt, ins_base, spans (C,S,4)).
+    Returns (base_at (C,S,out_len), ins_cnt, ins_base, pos_at,
+    spans (C,S,4)).
     """
     if out_len is None:
         out_len = drafts.shape[-1]

@@ -81,13 +81,17 @@ class BudgetModel:
 
     def cluster_bytes(self, s_bucket: int, width: int,
                       band_width: int = 128,
-                      keep_final_pileup: bool = True) -> int:
+                      keep_final_pileup: bool = True,
+                      keep_pos: bool = False) -> int:
         traceback = 2 * s_bucket * width * band_width  # tdir+fjump u8 planes
-        # base_at/ins_cnt/ins_base; keep_final_pileup (the rnn polish path,
-        # the default with bundled weights) transiently holds BOTH the
-        # accumulated per-part pileups and the full scatter buffers at
-        # compaction-scatter time (ADVICE r3), hence the extra copy
-        pileup = (2 if keep_final_pileup else 1) * s_bucket * width * (1 + 4 + 1)
+        # base_at/ins_cnt/ins_base (+ pos_at int32 only when the served
+        # polisher's v4 quality channels consume it, keep_pos);
+        # keep_final_pileup (the rnn polish path, the default with bundled
+        # weights) transiently holds BOTH the accumulated per-part pileups
+        # and the full scatter buffers at compaction-scatter time
+        # (ADVICE r3), hence the extra copy
+        per_cell = (1 + 4 + 1) + (4 if keep_pos else 0)
+        pileup = (2 if keep_final_pileup else 1) * s_bucket * width * per_cell
         votes = 2 * width * 4 * 8                      # vote stacks (int32)
         return traceback + pileup + votes
 
@@ -99,8 +103,9 @@ class BudgetModel:
 
     def cluster_batch(self, s_bucket: int, width: int,
                       band_width: int = 128,
-                      keep_final_pileup: bool = True) -> int:
+                      keep_final_pileup: bool = True,
+                      keep_pos: bool = False) -> int:
         per = self.cluster_bytes(s_bucket, width, band_width,
-                                 keep_final_pileup)
+                                 keep_final_pileup, keep_pos)
         hi = min(256, max(1, self.MAX_POLISH_LANES // max(s_bucket, 1)))
         return _pow2_floor(self.budget_bytes // per, 1, hi)

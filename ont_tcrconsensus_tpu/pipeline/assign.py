@@ -88,35 +88,37 @@ class ReferencePanel:
 # the fused device pass
 
 
-def _umi_windows(codes, lens_t, t_start, is_rev, umi_masks, umi_mask_lens,
+def _umi_windows(codes, lens_t, t_start, umi_masks, umi_mask_lens,
                  *, a5: int, a3: int) -> dict:
     """Fwd/rev UMI pattern search in both adapter windows — ONE dispatch.
 
-    a5/a3 are MOLECULE-frame budgets (the reference measures softclips on
-    the BAM-oriented read, region_split.py:226-227) but these windows
-    slice the PHYSICAL read (the mutually-revcomp UMI patterns make the
-    pattern choice strand-agnostic), so the per-side budgets swap for
-    reverse-strand reads: a minus read's physical 5' end carries the
-    molecule's 3' structure. Symmetric-ish defaults (81/76) hide this;
-    an asymmetric config (long 5' flank) would otherwise clip the
-    fwd UMI out of minus reads' 3' window.
+    Window budgets are FIXED in the physical read frame, strand-independent:
+    the reference re-derives the sequencer-orientation read for minus-strand
+    alignments (``get_forward_sequence()``, region_split.py:493-500) and
+    then always slices ``seq[:a5]`` / ``seq[-a3:]`` on it
+    (extract_umis.py:120-121) — so a minus read's physical 5' window gets
+    the a5 budget even though it carries the molecule's 3' structure. An
+    earlier revision swapped the budgets per strand (molecule-frame
+    reasoning); ADVICE r4 flagged that as a real divergence — with
+    asymmetric budgets it moves the window edge 5 nt on minus reads —
+    so this follows the reference exactly (tests/test_assign_band.py
+    pins the a5 != a3 strand case). The mutually-revcomp UMI patterns
+    keep the pattern search itself strand-agnostic.
     """
     B, W = codes.shape
     aw = max(a5, a3)
-    bw5 = jnp.where(is_rev, a3, a5)
-    bw3 = jnp.where(is_rev, a5, a3)
     pos_w = jnp.arange(aw, dtype=jnp.int32)[None, :]
     idx5 = jnp.clip(t_start[:, None] + pos_w, 0, W - 1)
     w5 = jnp.take(jnp.asarray(encode.CODE_TO_MASK),
                   jnp.take_along_axis(codes, idx5, axis=1).astype(jnp.int32))
-    w5 = jnp.where(pos_w < bw5[:, None], w5, jnp.uint8(0))
-    l5 = jnp.minimum(lens_t, bw5)
-    start3 = jnp.maximum(lens_t - bw3, 0)  # trimmed-frame coords (downstream)
+    w5 = jnp.where(pos_w < a5, w5, jnp.uint8(0))
+    l5 = jnp.minimum(lens_t, a5)
+    start3 = jnp.maximum(lens_t - a3, 0)  # trimmed-frame coords (downstream)
     idx3 = jnp.clip((t_start + start3)[:, None] + pos_w, 0, W - 1)
     w3 = jnp.take(jnp.asarray(encode.CODE_TO_MASK),
                   jnp.take_along_axis(codes, idx3, axis=1).astype(jnp.int32))
-    w3 = jnp.where(pos_w < bw3[:, None], w3, jnp.uint8(0))
-    l3 = jnp.minimum(lens_t, bw3)
+    w3 = jnp.where(pos_w < a3, w3, jnp.uint8(0))
+    l3 = jnp.minimum(lens_t, a3)
     ud, us, ue = fuzzy_match.fuzzy_find_multi(
         umi_masks, umi_mask_lens,
         jnp.concatenate([w5, w3], axis=0),
@@ -190,7 +192,7 @@ def _targeted_pass(
         best = {k: jnp.where(better, cur[k], best[k]) for k in best}
 
     umi_out = _umi_windows(
-        codes, lens_t, t_start, is_rev, umi_masks, umi_mask_lens, a5=a5, a3=a3
+        codes, lens_t, t_start, umi_masks, umi_mask_lens, a5=a5, a3=a3
     )
     blast_id = best["n_match"] / jnp.maximum(best["n_cols"], 1)
     return {
@@ -373,7 +375,7 @@ def _fused_pass(
 
     # --- UMI fuzzy location in both adapter windows (extract_umis.py:19-126)
     umi_out = _umi_windows(
-        codes, lens_t, t_start, is_rev, umi_masks, umi_mask_lens, a5=a5, a3=a3
+        codes, lens_t, t_start, umi_masks, umi_mask_lens, a5=a5, a3=a3
     )
 
     blast_id = best["n_match"] / jnp.maximum(best["n_cols"], 1)
@@ -406,6 +408,11 @@ class ReadBlock:
     ref_start: np.ndarray    # (n,) int32 — aligned reference span (exclusive end)
     ref_end: np.ndarray
     umi: dict[str, np.ndarray]  # d5,s5,e5,d3,s3,e3,start3 — (n,) int32 each
+    # (n, W) uint8 phred, trimmed in the same frame as codes; None for
+    # FASTA input. Kept for the polisher's v4 quality channels — quals are
+    # uint8 like codes, so the store's survivor footprint doubles, still
+    # far under the streamed-ingest ceiling (STREAMING_INGEST.md).
+    quals: np.ndarray | None = None
 
     @property
     def num_reads(self) -> int:
@@ -889,8 +896,13 @@ def run_assign(
         shifted = np.take_along_axis(batch.codes[rows], shift_idx, axis=1)
         in_new = np.arange(Wb)[None, :] < lens[rows][:, None]
         trimmed_codes = np.where(in_new, shifted, encode.PAD_CODE).astype(np.uint8)
+        trimmed_quals = None
+        if batch.quals is not None:
+            q_shift = np.take_along_axis(batch.quals[rows], shift_idx, axis=1)
+            trimmed_quals = np.where(in_new, q_shift, 0).astype(np.uint8)
         acc[batch.width].append({
             "codes": trimmed_codes,
+            "quals": trimmed_quals,
             "lens": lens[rows],
             "is_rev": out["is_rev"][rows],
             "region_idx": out["ridx"][rows].astype(np.int32),
@@ -981,5 +993,7 @@ def run_assign(
             ref_start=np.concatenate([p["ref_start"] for p in parts]),
             ref_end=np.concatenate([p["ref_end"] for p in parts]),
             umi=umi,
+            quals=(np.concatenate([p["quals"] for p in parts])
+                   if all(p["quals"] is not None for p in parts) else None),
         ))
     return ReadStore(blocks=blocks), stats

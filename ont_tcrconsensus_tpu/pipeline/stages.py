@@ -196,6 +196,7 @@ def cluster_and_select(
         min_reads_per_cluster=min_reads_per_cluster,
         max_reads_per_cluster=max_reads_per_cluster,
         balance_strands=balance_strands,
+        identity=identity, mesh=mesh,
     )
 
 
@@ -228,33 +229,290 @@ def cluster_and_select_grouped(
     groups = [[r.combined for r in recs] for _, recs in eligibles]
     clusters_list = umi_mod.cluster_umis_grouped(groups, identity, mesh=mesh)
     out: dict[str, tuple[list[SelectedCluster], list[dict]]] = {}
+    # first selection pass (host-only), collecting the rescue work so the
+    # second-chance device half runs ONCE across all groups (code-review
+    # r5: per-group rescue dispatches would reintroduce the latency tax
+    # this grouped driver exists to remove)
+    rescue_work: list[tuple] = []
+    first_pass: dict[str, tuple] = {}
     for (name, recs), clusters in zip(eligibles, clusters_list):
         if not recs:
             out[name] = ([], [])
             continue
-        out[name] = _select_from_clusters(
-            recs, clusters,
-            min_reads_per_cluster=min_reads_per_cluster,
-            max_reads_per_cluster=max_reads_per_cluster,
-            balance_strands=balance_strands,
+        members = _group_members(recs, clusters.labels)
+        selected, stat_rows, taken = _run_selection(
+            members, min_reads_per_cluster, max_reads_per_cluster,
+            balance_strands,
         )
+        first_pass[name] = (recs, clusters, selected, stat_rows)
+        if min_reads_per_cluster > 1:
+            rescue_work.append((name, recs, clusters, members, taken))
+    roots_by = (
+        _rescue_grouped(rescue_work, identity, mesh=mesh)
+        if rescue_work else {}
+    )
+    for name, (recs, clusters, selected, stat_rows) in first_pass.items():
+        roots = roots_by.get(name)
+        if roots is not None:
+            selected, stat_rows, _ = _run_selection(
+                _group_members(recs, clusters.labels, roots),
+                min_reads_per_cluster, max_reads_per_cluster,
+                balance_strands,
+            )
+        out[name] = (selected, stat_rows)
     return out
 
 
-def _select_from_clusters(
+#: relaxed dovetail free-end budget for the second-chance UMI pass — one
+#: notch above the clustering default (ops/edit_distance k_end=8): enough to
+#: forgive deeper extraction-boundary erosion, far too small to bridge
+#: distinct molecules (~0.6 identity on random 64 nt UMIs).
+RESCUE_K_END = 16
+
+
+def _rescue_identities(codes, lens, sub_global, gid, rescue_k_end, mesh=None):
+    """(n_sub, K+1) candidate centroid indices + relaxed-end identities.
+
+    Shared device half of the rescue pass: k-mer shortlist over ALL
+    centroid rows, then exact dovetail distances with ``rescue_k_end``
+    free ends on the flattened pair list (pow2-padded for stable compile
+    shapes). Self entries — and, when ``gid`` is given, cross-group
+    entries — are forced to identity -1 so they never form edges. The
+    shortlist needs no group-awareness: same-molecule variants always
+    outrank random cross-group UMIs in k-mer dot product (the same
+    argument as cluster_umis_grouped).
+    """
+    from ont_tcrconsensus_tpu.ops import edit_distance, sketch
+
+    # pow2-pad BOTH axes so the jitted profile/top_k kernels compile once
+    # per size class, not once per centroid count (code-review r5 — the
+    # same discipline as cluster.umi._neighbor_identities); padded target
+    # rows are zero-length (ident forced -1 below via longest==0), padded
+    # query rows repeat row 0 and are sliced off before the merge.
+    n_all = codes.shape[0]
+    n_pad = bucketing.pow2_ceil(n_all, 16)
+    if n_pad > n_all:
+        codes = np.concatenate(
+            [codes, np.zeros((n_pad - n_all, codes.shape[1]), codes.dtype)]
+        )
+        lens = np.concatenate([lens, np.zeros(n_pad - n_all, lens.dtype)])
+    n_sub = len(sub_global)
+    q_pad = bucketing.pow2_ceil(n_sub, 16)
+    sub_q = np.concatenate(
+        [sub_global, np.zeros(q_pad - n_sub, np.int32)]
+    ) if q_pad > n_sub else np.asarray(sub_global, np.int32)
+    # k=4 exact (dim=None) profiles: the UMI-scale shortlist the clustering
+    # pass uses — the read-scale hashed default is the wrong instrument for
+    # 64 nt UMIs
+    profiles = np.asarray(sketch.kmer_profile(codes, lens, k=4, dim=None))
+    K = min(8, n_all - 1)
+    cand = np.asarray(
+        sketch.top_candidates(profiles[sub_q], profiles, K + 1)
+    )[:n_sub]  # (n_sub, K+1) — may include self / padded rows
+    qi = np.repeat(sub_global, K + 1)
+    ti = cand.reshape(-1).astype(np.int32)
+    n_pairs = len(qi)
+    n_padded = bucketing.pow2_ceil(n_pairs)
+    if n_padded > n_pairs:
+        pad = n_padded - n_pairs
+        qi = np.concatenate([qi, np.zeros(pad, np.int32)])
+        ti = np.concatenate([ti, np.zeros(pad, np.int32)])
+    d = np.asarray(edit_distance.pairwise_dovetail_auto(
+        codes[qi], lens[qi], codes[ti], lens[ti],
+        k_end=rescue_k_end, mesh=mesh,
+    )).astype(np.float32)[:n_pairs]
+    longest = np.maximum(lens[qi[:n_pairs]], lens[ti[:n_pairs]]).astype(np.float32)
+    ident = np.where(longest > 0, 1.0 - d / np.maximum(longest, 1.0), -1.0)
+    ident = ident.reshape(len(sub_global), K + 1)
+    ident[cand == np.asarray(sub_global)[:, None]] = -1.0  # never self-merge
+    padded_target = cand >= n_all  # zero-profile padding rows: never edges
+    ident[padded_target] = -1.0
+    if gid is not None:
+        safe_cand = np.where(padded_target, 0, cand)
+        ident[gid[safe_cand] != gid[sub_global][:, None]] = -1.0
+        ident[padded_target] = -1.0
+    return cand, ident
+
+
+def _rescue_merge_roots(subs, n_c, cand_local, ident, identity, taken):
+    """Host half: single-best-edge union-find over one group's clusters.
+
+    ``cand_local`` rows are group-local centroid indices aligned with
+    ``subs``; entries with ident -1 (self/cross-group/padding) never win.
+    Each merged component is labeled by its SURVIVING cluster's id when one
+    exists (fragments joining survivor 5 emit as cluster 5 — a surviving
+    cluster's header/stat-row id must never churn because a fragment
+    rescued into it; code-review r5), else by its smallest fragment id.
+    Returns {cluster_id: root_id} or None when nothing merged.
+    """
+    parent = np.arange(n_c)
+
+    def find(x: int) -> int:
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    merged = False
+    for row, cid in enumerate(subs):
+        ok = ident[row] >= identity
+        if not ok.any():
+            continue
+        # single best edge: highest identity, ties -> smaller cluster id
+        best_ident = ident[row][ok].max()
+        best = int(cand_local[row][ok & (ident[row] >= best_ident)].min())
+        a, b = find(cid), find(best)
+        if a != b:
+            parent[max(a, b)] = min(a, b)
+            merged = True
+    if not merged:
+        return None
+    # component label: the survivor if present (at most one — survivors
+    # carry no out-edges, so two can never connect), else min fragment id
+    comp_members: dict[int, list[int]] = defaultdict(list)
+    for c in range(n_c):
+        comp_members[find(c)].append(c)
+    label: dict[int, int] = {}
+    for root, cs in comp_members.items():
+        surv = [c for c in cs if c in taken]
+        label[root] = surv[0] if surv else min(cs)
+    return {c: label[find(c)] for c in range(n_c)}
+
+
+def _rescue_subs(members: dict, taken: set) -> list[int]:
+    return [cid for cid in sorted(members) if cid not in taken and members[cid]]
+
+
+def _rescue_subthreshold(
     eligible: list[UmiRecord],
     clusters,
+    members: dict[int, list[UmiRecord]],
+    taken: set[int],
+    identity: float,
+    rescue_k_end: int = RESCUE_K_END,
+    mesh=None,
+) -> dict[int, int] | None:
+    """Second-chance pass for clusters that failed min_reads_per_cluster.
+
+    The lane-scale loss chain (LANE_SCALE_R4.md): a molecule's reads can
+    split 2+1+1 across UMI clusters when extraction-boundary erosion
+    exceeds the dovetail free-end budget, and every fragment then falls
+    below ``min_reads_per_cluster`` — the molecule vanishes (undercount).
+    vsearch has no such pass; the reference simply loses these molecules
+    too, but the counts contract here is bit-exactness against ground
+    truth, so the split is healed deterministically (DIVERGENCES.md #11):
+
+    - each sub-threshold cluster's CENTROID UMI is re-scored against the
+      other cluster centroids (k-mer shortlist, then exact dovetail with
+      ``rescue_k_end`` free ends — one notch above the clustering pass);
+    - it merges into its single best match at >= the SAME identity
+      threshold (ties: smaller cluster id). One out-edge per sub-threshold
+      cluster means two surviving clusters can never become connected, so
+      well-formed molecules are never joined; fragments can chain into
+      each other or into a survivor, exactly healing the 2+1+1 case.
+
+    This is the SINGLE-GROUP path (cluster_and_select); the grouped
+    driver batches the device half across all groups instead
+    (:func:`_rescue_grouped`, code-review r5: per-group dispatches would
+    reintroduce the latency tax the grouped UMI stage exists to remove).
+
+    Returns {cluster_id: root_id} for every cluster, or None when nothing
+    merged.
+    """
+    subs = _rescue_subs(members, taken)
+    n_c = clusters.num_clusters
+    if not subs or n_c < 2:
+        return None
+    cent_strs = [
+        eligible[int(clusters.centroid_of[c])].combined for c in range(n_c)
+    ]
+    codes, lens = encode.encode_batch(cent_strs, pad_to=128)
+    cand, ident = _rescue_identities(
+        codes, lens, np.asarray(subs, np.int32), None, rescue_k_end, mesh=mesh
+    )
+    return _rescue_merge_roots(subs, n_c, cand, ident, identity, taken)
+
+
+def _rescue_grouped(
+    work: list[tuple],
+    identity: float,
+    rescue_k_end: int = RESCUE_K_END,
+    mesh=None,
+) -> dict:
+    """Batched :func:`_rescue_subthreshold` over many groups.
+
+    ``work``: [(key, eligible, clusters, members, taken), ...]. ONE
+    k-mer-profile + shortlist + dovetail dispatch covers every group's
+    centroids (cross-group identities masked to -1 before any edge is
+    formed), then the union-find runs host-side per group — the same
+    batching shape as cluster_umis_grouped. Returns {key: roots|None}.
+    """
+    per_group = []
+    cent_all: list[str] = []
+    offsets = [0]
+    gids: list[int] = []
+    subs_global: list[int] = []
+    for g, (key, eligible, clusters, members, taken) in enumerate(work):
+        subs = _rescue_subs(members, taken)
+        n_c = clusters.num_clusters
+        s = offsets[-1]
+        if not subs or n_c < 2:
+            per_group.append((key, None, None, s, taken))
+            continue
+        cent_all.extend(
+            eligible[int(clusters.centroid_of[c])].combined
+            for c in range(n_c)
+        )
+        gids.extend([g] * n_c)
+        subs_global.extend(s + c for c in subs)
+        offsets.append(s + n_c)
+        per_group.append((key, subs, n_c, s, taken))
+    out = {key: None for key, *_ in per_group}
+    if not subs_global or len(cent_all) < 2:
+        return out
+    codes, lens = encode.encode_batch(cent_all, pad_to=128)
+    cand, ident = _rescue_identities(
+        codes, lens, np.asarray(subs_global, np.int32),
+        np.asarray(gids, np.int32), rescue_k_end, mesh=mesh,
+    )
+    row = 0
+    for key, subs, n_c, s, taken in per_group:
+        if subs is None:
+            continue
+        rows = slice(row, row + len(subs))
+        row += len(subs)
+        cand_local = cand[rows] - s
+        ident_g = ident[rows].copy()
+        oob = (cand_local < 0) | (cand_local >= n_c)
+        cand_local = np.where(oob, 0, cand_local)
+        ident_g[oob] = -1.0  # already -1 via gid mask; belt and braces
+        out[key] = _rescue_merge_roots(subs, n_c, cand_local, ident_g,
+                                       identity, taken)
+    return out
+
+
+def _group_members(eligible, labels, roots=None) -> dict[int, list[UmiRecord]]:
+    """Cluster-id -> members, in eligible (first-come) order; ``roots``
+    remaps ids through rescue merges so merged clusters read exactly as if
+    vsearch had joined them."""
+    members: dict[int, list[UmiRecord]] = defaultdict(list)
+    for rec, lab in zip(eligible, labels):
+        cid = int(lab)
+        members[roots[cid] if roots else cid].append(rec)
+    return members
+
+
+def _run_selection(
+    members: dict[int, list[UmiRecord]],
     min_reads_per_cluster: int,
     max_reads_per_cluster: int,
     balance_strands: bool,
-) -> tuple[list[SelectedCluster], list[dict]]:
-    """Subread selection + stats rows for one group's cluster labels."""
-    members: dict[int, list[UmiRecord]] = defaultdict(list)
-    for rec, lab in zip(eligible, clusters.labels):
-        members[int(lab)].append(rec)
-
+) -> tuple[list[SelectedCluster], list[dict], set[int]]:
+    """The polish_cluster strand math (parse_umi_clusters.py:67-116) over
+    one group's member map; returns (selected, stats rows, taken ids)."""
     selected: list[SelectedCluster] = []
     stat_rows: list[dict] = []
+    taken: set[int] = set()
     for cid in sorted(members):
         mem = members[cid]
         fwd = [m for m in mem if m.strand == "+"]
@@ -287,12 +545,40 @@ def _select_from_clusters(
         }
         stat_rows.append(row)
         if chosen:
+            taken.add(cid)
             selected.append(SelectedCluster(
                 cluster_id=cid, members=chosen,
                 n_fwd=n_fwd, n_rev=n_rev,
                 written_fwd=row["written_fwd"], written_rev=row["written_rev"],
                 n_found=len(mem),
             ))
+    return selected, stat_rows, taken
+
+
+def _select_from_clusters(
+    eligible: list[UmiRecord],
+    clusters,
+    min_reads_per_cluster: int,
+    max_reads_per_cluster: int,
+    balance_strands: bool,
+    identity: float | None = None,
+    rescue: bool = True,
+    mesh=None,
+) -> tuple[list[SelectedCluster], list[dict]]:
+    """Subread selection + stats rows for one group's cluster labels."""
+    members = _group_members(eligible, clusters.labels)
+    selected, stat_rows, taken = _run_selection(
+        members, min_reads_per_cluster, max_reads_per_cluster, balance_strands
+    )
+    if rescue and identity is not None and min_reads_per_cluster > 1:
+        roots = _rescue_subthreshold(
+            eligible, clusters, members, taken, identity, mesh=mesh
+        )
+        if roots is not None:
+            selected, stat_rows, _ = _run_selection(
+                _group_members(eligible, clusters.labels, roots),
+                min_reads_per_cluster, max_reads_per_cluster, balance_strands,
+            )
     return selected, stat_rows
 
 
@@ -370,14 +656,25 @@ def polish_clusters_all(
             group_prepared = []
             for cl in selected:
                 rows_codes = []
+                rows_quals: list | None = []
+                rows_rev = []
                 max_len = 0
                 for m in cl.members:
                     blk = store.blocks[m.block]
                     ln = int(blk.lens[m.row])
                     c = blk.codes[m.row, :ln]
+                    q = blk.quals[m.row, :ln] if blk.quals is not None else None
                     if m.strand == "-":
                         c = encode.revcomp_codes(c)
+                        # quals REVERSE (no complement) alongside the revcomp
+                        # so q[i] stays the phred of the base now at i
+                        q = q[::-1] if q is not None else None
                     rows_codes.append(c)
+                    if q is None:
+                        rows_quals = None
+                    elif rows_quals is not None:
+                        rows_quals.append(q)
+                    rows_rev.append(m.strand == "-")
                     max_len = max(max_len, ln)
                 # one lane-width of growth slack above the longest subread
                 need = max_len + 128
@@ -387,18 +684,29 @@ def polish_clusters_all(
                 )
                 codes, lens = encode.pad_batch(rows_codes, pad_to=width, multiple=128)
                 s_bucket = bucketing.pow2_ceil(len(rows_codes))
+                quals = None
+                if rows_quals is not None:
+                    quals = np.zeros((s_bucket, codes.shape[1]), np.uint8)
+                    for i, q in enumerate(rows_quals):
+                        quals[i, : len(q)] = q
+                strands = np.zeros(s_bucket, bool)
+                strands[: len(rows_rev)] = rows_rev
                 if s_bucket > len(rows_codes):
                     pad_rows = s_bucket - len(rows_codes)
                     codes = np.concatenate(
                         [codes, np.full((pad_rows, codes.shape[1]), encode.PAD_CODE, np.uint8)]
                     )
                     lens = np.concatenate([lens, np.zeros(pad_rows, lens.dtype)])
-                group_prepared.append((s_bucket, codes.shape[1], cl, codes, lens))
+                group_prepared.append(
+                    (s_bucket, codes.shape[1], cl, codes, lens, quals, strands)
+                )
         except Exception as exc:
             failed[group_name] = repr(exc)
             continue
-        for s_bucket, width, cl, codes, lens in group_prepared:
-            prepared[(s_bucket, width)].append((group_name, cl, codes, lens))
+        for s_bucket, width, cl, codes, lens, quals, strands in group_prepared:
+            prepared[(s_bucket, width)].append(
+                (group_name, cl, codes, lens, quals, strands)
+            )
     for (s_bucket, width), items in sorted(prepared.items()):
         # Band scales with the width bucket: +/-32 is >4 sigma of same-
         # molecule drift up to ~2 kb, but cumulative indel drift grows with
@@ -407,11 +715,13 @@ def polish_clusters_all(
         eff_band = band_width if width <= 2048 else max(band_width, 128)
         # cluster-tile batch from the HBM budget (the medaka memory-model
         # analogue, parallel/budget.py) unless explicitly overridden
+        keep_pos = bool(getattr(polisher, "wants_v4", False))
         if cluster_batch is not None:
             cb = cluster_batch
         elif budget is not None:
             cb = budget.cluster_batch(s_bucket, width, eff_band,
-                                      keep_final_pileup=polisher is not None)
+                                      keep_final_pileup=polisher is not None,
+                                      keep_pos=keep_pos)
         else:
             cb = 16
         # never pad the cluster axis past the work available (a small
@@ -428,26 +738,39 @@ def polish_clusters_all(
             chunk = items[start : start + cb]
             C = len(chunk)
             try:
-                sub = np.stack([codes for _, _, codes, _ in chunk])
-                lens = np.stack([ln for _, _, _, ln in chunk])
+                sub = np.stack([codes for _, _, codes, _, _, _ in chunk])
+                lens = np.stack([ln for _, _, _, ln, _, _ in chunk])
+                have_quals = all(q is not None for _, _, _, _, q, _ in chunk)
+                quals = (np.stack([q for _, _, _, _, q, _ in chunk])
+                         if have_quals else None)
+                strands = np.stack([s for _, _, _, _, _, s in chunk])
                 if C < cb:  # pad the cluster axis: stable compile shapes
                     pad = cb - C
                     sub = np.concatenate(
                         [sub, np.full((pad, s_bucket, width), encode.PAD_CODE, np.uint8)]
                     )
                     lens = np.concatenate([lens, np.zeros((pad, s_bucket), lens.dtype)])
+                    if quals is not None:
+                        quals = np.concatenate(
+                            [quals, np.zeros((pad, s_bucket, width), np.uint8)]
+                        )
+                    strands = np.concatenate(
+                        [strands, np.zeros((pad, s_bucket), bool)]
+                    )
                 drafts, dlens, *rest = consensus_mod.consensus_clusters_batch(
                     sub, lens, rounds=rounds, band_width=eff_band,
-                    keep_final_pileup=polisher is not None, mesh=mesh,
+                    keep_final_pileup=polisher is not None,
+                    keep_pos=keep_pos, mesh=mesh,
                 )
                 if polisher is not None:
                     drafts, dlens = polisher(
                         sub, lens, drafts, dlens, pileup=rest[0],
                         band_width=eff_band, mesh=mesh,
+                        quals=quals, strands=strands,
                     )
                 seqs = encode.decode_batch(drafts[:C], dlens[:C])
             except Exception as exc:
-                for group_name, _, _, _ in chunk:
+                for group_name, *_ in chunk:
                     failed.setdefault(group_name, repr(exc))
                 continue
             for c in range(C):

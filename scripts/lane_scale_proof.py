@@ -156,14 +156,38 @@ def main() -> int:
     }
 
     timing = {}
-    tsv = os.path.join(root, "fastq_pass", "nano_tcr", "barcode01",
-                       "logs", "stage_timing.tsv")
+    logs_dir = os.path.join(root, "fastq_pass", "nano_tcr", "barcode01", "logs")
+    tsv = os.path.join(logs_dir, "stage_timing.tsv")
     if os.path.exists(tsv):
         with open(tsv) as fh:
             next(fh)
             for line in fh:
                 stage, sec, _ = line.split("\t")
                 timing[stage] = round(float(sec), 1)
+
+    # depth -> precision from the pipeline's OWN round-2 artifact (VERDICT
+    # r4 #9): the depth-3 gate policy debate runs on this table, produced
+    # by qc.analysis.estimate_precision_at_num_subreads (ref
+    # minimap2_align.py:362-435) over merged_consensus QC rows.
+    precision_at_depth = None
+    sub_csv = os.path.join(
+        logs_dir, "merged_consensus_number_of_subreads_blast_id.csv"
+    )
+    if os.path.exists(sub_csv):
+        from ont_tcrconsensus_tpu.qc.analysis import (
+            estimate_precision_at_num_subreads,
+        )
+
+        rows = []
+        with open(sub_csv) as fh:
+            next(fh)
+            for line in fh:
+                n, b = line.rstrip("\n").split(",")
+                rows.append((n, float(b)))
+        precision_at_depth = {
+            str(k): v
+            for k, v in estimate_precision_at_num_subreads(rows).items()
+        }
 
     import jax
 
@@ -177,6 +201,7 @@ def main() -> int:
         "counts_exact": counts_exact,
         "count_diffs": dict(list(diffs.items())[:20]),
         "heavy_region_count": (got.get(heavy_region, 0), heavy_molecules),
+        "precision_at_depth": precision_at_depth,
         "stage_timing_sec": timing,
         "peak_device_mem_gb": peak_device_memory_gb(),
         "peak_host_rss_gb": round(

@@ -57,14 +57,22 @@ def test_one_sided_trim_reads_stay_in_band():
             assert panel.names[int(blk.region_idx[i])] == region_of_mol[mol]
 
 
-def test_asymmetric_softclip_budgets_window_minus_strand_umis():
-    """a5/a3 are MOLECULE-frame budgets; the fused pass slices PHYSICAL
-    windows, so it must swap the budgets for reverse-strand reads
-    (code-review r4 finding). A long 5' flank (a5=160 >> a3=60) would
-    otherwise clip the fwd UMI out of minus reads' physical 3' window.
+def test_asymmetric_softclip_budgets_fixed_physical_windows():
+    """UMI windows are FIXED in the physical read frame, strand-independent
+    (ADVICE r4): the reference hands extract_umis the sequencer-orientation
+    read (region_split.py:493-500 get_forward_sequence) and always slices
+    seq[:a5] / seq[-a3:] (extract_umis.py:120-121) — it never swaps budgets
+    per strand. An earlier revision swapped them (molecule-frame
+    reasoning); this pins the parity behavior with budgets asymmetric
+    enough (a5=160 >> a3=60, left flank 100 nt) to tell the two apart:
 
-    Clean reads (no errors): every UMI must be found at distance 0 on
-    BOTH strands."""
+    - plus reads find both UMIs (each inside its window);
+    - minus reads find the physical-5' UMI (revcomp of the molecule 3'
+      structure, well inside the 160 window) but MISS the physical-3' one
+      (the molecule 5' flank ends 100 nt from the read end, outside the
+      60 window) — exactly as the reference would. The budget swap would
+      have found it (132 < 160), so a regression flips the assertion.
+    """
     from ont_tcrconsensus_tpu.io import bucketing
     from ont_tcrconsensus_tpu.ops import encode as enc
 
@@ -93,8 +101,22 @@ def test_asymmetric_softclip_budgets_window_minus_strand_umis():
     valid = batch.lengths > 0
     assert valid.sum() == 4
     assert out["is_rev"][valid].tolist() == [False, True, False, True]
-    assert (out["d5"][valid] == 0).all(), out["d5"][valid]
-    assert (out["d3"][valid] == 0).all(), out["d3"][valid]
+    plus = valid & ~out["is_rev"]
+    minus = valid & out["is_rev"]
+    assert (out["d5"][plus] == 0).all(), out["d5"][plus]
+    assert (out["d3"][plus] == 0).all(), out["d3"][plus]
+    assert (out["d5"][minus] == 0).all(), out["d5"][minus]
+    # molecule-5' UMI sits 100-132 nt from the minus read's physical 3'
+    # end: outside the fixed 60 nt window, so it must NOT be located
+    assert (out["d3"][minus] > 3).all(), out["d3"][minus]
+
+    # with both budgets covering both flanks the windows are sufficient on
+    # both strands — every UMI found, strand-independent
+    eng_wide = A.AssignEngine(panel, UMI_FWD, UMI_REV, primers=[],
+                              a5=160, a3=160)
+    out_w = eng_wide.run_batch(batch, max_ee_rate=0.07, min_len=500)
+    assert (out_w["d5"][valid] == 0).all(), out_w["d5"][valid]
+    assert (out_w["d3"][valid] == 0).all(), out_w["d3"][valid]
 
 
 def test_targeted_pass_agrees_with_fused_pass():

@@ -231,3 +231,113 @@ def test_error_rich_longest_read_does_not_fragment_molecule():
     assert len(set(labels[:6])) == 1, "molecule fragmented"
     assert len(set(labels[6:])) == 1
     assert labels[0] != labels[6]
+
+
+def test_umi_split_rescue_heals_2_1_1_fragmentation():
+    """The LANE_SCALE_R4 loss chain, reproduced and healed (VERDICT r4 #3):
+    a molecule's 4 reads carry combined UMIs eroded at the boundaries so
+    far (13-14 nt, beyond the clustering pass's 8 nt free-end budget) that
+    they split 2+1+1 across clusters; every fragment falls below
+    min_reads_per_cluster=4 and the molecule vanishes. The second-chance
+    pass re-tests sub-threshold centroids with the relaxed 16 nt budget
+    and must reassemble exactly one 4-member cluster — while leaving an
+    unrelated molecule's cluster untouched."""
+    from ont_tcrconsensus_tpu.pipeline import stages
+
+    rng = np.random.default_rng(42)
+    base = "".join("ACGT"[i] for i in rng.integers(0, 4, 64))
+    other = "".join("ACGT"[i] for i in rng.integers(0, 4, 64))
+
+    def rec(name, combined, strand="+"):
+        return stages.UmiRecord(
+            name=name, strand=strand, umi_fwd_dist=0, umi_rev_dist=0,
+            umi_fwd_seq=combined[:32], umi_rev_seq=combined[32:],
+            combined=combined, block=0, row=0,
+        )
+
+    records = [
+        rec("a", base), rec("b", base, "-"),          # intact pair
+        rec("c", base[13:]),                          # 13 nt 5' erosion
+        rec("d", base[:-14], "-"),                    # 14 nt 3' erosion
+        # unrelated molecule, 4 intact reads: must stay its own cluster
+        rec("e", other), rec("f", other, "-"),
+        rec("g", other), rec("h", other, "-"),
+    ]
+    kw = dict(
+        identity=0.93, min_umi_length=40, max_umi_length=70,
+        min_reads_per_cluster=4, max_reads_per_cluster=20,
+        balance_strands=False,
+    )
+    selected, stat_rows = stages.cluster_and_select(records, **kw)
+    names = sorted(
+        tuple(sorted(m.name for m in s.members)) for s in selected
+    )
+    assert names == [("a", "b", "c", "d"), ("e", "f", "g", "h")], names
+
+    # control: without the rescue the split molecule is lost entirely
+    eligible = [
+        r for r in records if 40 <= len(r.combined) <= 70
+    ]
+    from ont_tcrconsensus_tpu.cluster import umi as umi_mod
+
+    clusters = umi_mod.cluster_umis([r.combined for r in eligible], 0.93)
+    sel_off, _ = stages._select_from_clusters(
+        eligible, clusters, min_reads_per_cluster=4,
+        max_reads_per_cluster=20, balance_strands=False,
+        identity=0.93, rescue=False,
+    )
+    assert sorted(
+        tuple(sorted(m.name for m in s.members)) for s in sel_off
+    ) == [("e", "f", "g", "h")]
+
+
+def test_umi_split_rescue_grouped_matches_per_group():
+    """The grouped driver batches the rescue's device half across groups
+    (one dispatch set); results must equal the per-group path exactly —
+    including the healed 2+1+1 group — and cross-group UMIs must never
+    merge even when identical."""
+    from ont_tcrconsensus_tpu.pipeline import stages
+
+    rng = np.random.default_rng(43)
+    base = "".join("ACGT"[i] for i in rng.integers(0, 4, 64))
+    other = "".join("ACGT"[i] for i in rng.integers(0, 4, 64))
+
+    def rec(name, combined, strand="+"):
+        return stages.UmiRecord(
+            name=name, strand=strand, umi_fwd_dist=0, umi_rev_dist=0,
+            umi_fwd_seq=combined[:32], umi_rev_seq=combined[32:],
+            combined=combined, block=0, row=0,
+        )
+
+    g1 = [
+        rec("a", base), rec("b", base, "-"),
+        rec("c", base[13:]), rec("d", base[:-14], "-"),
+        rec("e", other), rec("f", other, "-"),
+        rec("g", other), rec("h", other, "-"),
+    ]
+    # group 2 carries the SAME eroded base UMI as g1's fragments: its
+    # singletons must rescue only within their own group (here: no
+    # survivor or sibling fragment close enough -> stays lost)
+    g2 = [
+        rec("x", base[13:]),
+        rec("p", other), rec("q", other, "-"),
+        rec("r", other), rec("s", other, "-"),
+    ]
+    kw = dict(
+        identity=0.93, min_umi_length=40, max_umi_length=70,
+        min_reads_per_cluster=4, max_reads_per_cluster=20,
+        balance_strands=False,
+    )
+    grouped = stages.cluster_and_select_grouped(
+        [("g1", g1), ("g2", g2)], **kw
+    )
+    sel1, _ = stages.cluster_and_select(g1, **kw)
+    sel2, _ = stages.cluster_and_select(g2, **kw)
+
+    def names(selected):
+        return sorted(tuple(sorted(m.name for m in s.members)) for s in selected)
+
+    assert names(grouped["g1"][0]) == names(sel1) == [
+        ("a", "b", "c", "d"), ("e", "f", "g", "h")
+    ]
+    assert names(grouped["g2"][0]) == names(sel2) == [("p", "q", "r", "s")]

@@ -15,7 +15,7 @@ def _pad(seq_codes, width):
 def _pile_one(read_str, draft_str, width=128, band=64):
     read = encode.encode_seq(read_str)
     draft = encode.encode_seq(draft_str)
-    base_at, ins_cnt, ins_base, spans = pileup.pileup_columns(
+    base_at, ins_cnt, ins_base, _pos, spans = pileup.pileup_columns(
         _pad(read, width)[None, :],
         np.array([len(read)], np.int32),
         _pad(draft, width),
@@ -177,7 +177,7 @@ def test_pileup_pallas_forward_matches_xla():
     got = pileup.pileup_columns_batch_auto(
         sub, lens, drafts, dlens, band_width=64, out_len=W, force_pallas=True
     )
-    for a, b, name in zip(ref, got, ("base_at", "ins_cnt", "ins_base", "spans")):
+    for a, b, name in zip(ref, got, ("base_at", "ins_cnt", "ins_base", "pos_at", "spans")):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
 
 
@@ -215,9 +215,9 @@ def test_scan_traceback_matches_while_loop():
         band_width=64,
     )
     got = pileup._traceback_batch(best, planes, reads, 64, W)
-    shapes = [(C, S, W), (C, S, W), (C, S, W), (C, S, 4)]
+    shapes = [(C, S, W), (C, S, W), (C, S, W), (C, S, W), (C, S, 4)]
     for a, b, shp, name in zip(
-        ref, got, shapes, ("base_at", "ins_cnt", "ins_base", "spans")
+        ref, got, shapes, ("base_at", "ins_cnt", "ins_base", "pos_at", "spans")
     ):
         np.testing.assert_array_equal(
             np.asarray(a), np.asarray(b).reshape(shp), err_msg=name
@@ -254,5 +254,5 @@ def test_pileup_pallas_full_width_draft():
     got = pileup.pileup_columns_batch_auto(
         sub, lens, drafts, dlens, band_width=64, out_len=W, force_pallas=True
     )
-    for a, b, name in zip(ref, got, ("base_at", "ins_cnt", "ins_base", "spans")):
+    for a, b, name in zip(ref, got, ("base_at", "ins_cnt", "ins_base", "pos_at", "spans")):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)

@@ -51,9 +51,14 @@ def test_bucketing_widths_and_padding():
     assert b256.codes.shape == (4, 256)
     assert list(b256.lengths[:2]) == [100, 100]
     assert b256.ids[:2] == ["a", "c"]
-    # padding rows are PAD everywhere, qual 93
+    # padding rows are PAD everywhere; the qual filler is QUAL_FILL (the
+    # in-distribution mid-range the polisher fallback/training use — inert
+    # for quality-carrying rows since spans never reach padding, but a
+    # quality-LESS row in a mixed stream exposes it, code-review r5)
+    from ont_tcrconsensus_tpu.ops.consensus import QUAL_FILL
+
     assert (b256.codes[2:] == 5).all()
-    assert (b256.quals[2:] == 93).all()
+    assert (b256.quals[2:] == QUAL_FILL).all()
 
 
 def test_bucketing_drops_out_of_range():
@@ -110,3 +115,17 @@ def test_config_json_roundtrip(tmp_path):
     p.write_text(json.dumps({"reference_file": "r.fa", "fastq_pass_dir": "fq", "minimal_length": 99}))
     cfg = RunConfig.from_json(p)
     assert cfg.minimal_length == 99
+
+
+def test_fasta_batches_have_no_quals():
+    """FASTA records (quality=None) must yield batch.quals=None — an
+    all-93 filler array would poison the v4 polisher's quality channels
+    (code-review r5); FASTQ records keep their phred array."""
+    from ont_tcrconsensus_tpu.io import bucketing, fastx
+
+    fa = [fastx.FastxRecord(f"r{i}", "", "ACGT" * 50, None) for i in range(3)]
+    fq = [fastx.FastxRecord(f"r{i}", "", "ACGT" * 50, "I" * 200) for i in range(3)]
+    (b_fa,) = list(bucketing.batch_reads(fa, batch_size=8))
+    (b_fq,) = list(bucketing.batch_reads(fq, batch_size=8))
+    assert b_fa.quals is None
+    assert b_fq.quals is not None and (b_fq.quals[0, :200] == ord("I") - 33).all()

@@ -178,3 +178,147 @@ def test_two_pass_polish_contract():
         np.zeros((1,), np.int32),
     )
     assert l0[0] == 0
+
+
+# ---------------------------------------------------------------------------
+# v4: strand + quality features (VERDICT r4 #6)
+
+
+def test_pileup_features_v4_channels():
+    """The strand split and quality weighting must reflect the inputs:
+    fwd/rev counts partition the plain counts, and a high-qual base vote
+    carries more quality-weighted mass than a low-qual one."""
+    import jax.numpy as jnp
+
+    from ont_tcrconsensus_tpu.ops import consensus, pileup
+
+    S, W = 4, 32
+    draft = np.zeros(W, np.uint8)  # all A
+    base_at = np.full((S, W), pileup.UNCOVERED, np.uint8)
+    pos_at = np.full((S, W), -1, np.int32)
+    base_at[:, :8] = 0          # four A votes on columns 0-7
+    base_at[3, 4] = 2           # one dissenting G at column 4
+    pos_at[:, :8] = np.arange(8)[None, :]
+    quals = np.full((S, W), 10, np.uint8)
+    quals[3, :] = 40            # the dissenter is high-quality
+    is_rev = np.array([False, False, True, True])
+    feats = np.asarray(consensus.pileup_features_v4(
+        jnp.asarray(base_at), jnp.zeros((S, W), jnp.int32),
+        jnp.zeros((S, W), jnp.uint8), jnp.asarray(draft),
+        jnp.asarray(pos_at), jnp.asarray(quals), jnp.asarray(is_rev),
+    ))
+    assert feats.shape == (W, consensus.FEATURE_DIM_V4)
+    assert np.isfinite(feats).all()
+    # column 0: 2 fwd A + 2 rev A -> strand channels split the count
+    assert np.isclose(feats[0, 0], np.log1p(2.0))   # fwd A
+    assert np.isclose(feats[0, 5], np.log1p(2.0))   # rev A
+    # column 4: A channel lost one vote to G on the rev strand
+    assert np.isclose(feats[4, 5], np.log1p(1.0))   # rev A
+    assert np.isclose(feats[4, 7], np.log1p(1.0))   # rev G
+    # quality-weighted: G's single Q40 vote (4.0) outweighs each A's Q10
+    qw_a, qw_g = feats[4, 10], feats[4, 12]
+    assert np.expm1(qw_g) > np.expm1(qw_a) / 3  # 4.0 vs 3.0 total A mass
+    # beyond the pileup: zero counts, finite
+    assert (feats[8:, :10] == 0).all()
+
+
+def test_make_examples_v4_shapes_and_signal():
+    ex = train.make_examples(
+        seed=3, n_examples=4, template_len=128, width=256, features="v4"
+    )
+    assert ex.feats.shape[2] == polisher.FEATURE_DIM_V4
+    assert np.isfinite(ex.feats).all()
+    # strand channels must both be populated across the pool (random
+    # orientation) — all-zero rev counts would mean orientation never fired
+    assert ex.feats[..., 5:10].sum() > 0
+    assert ex.feats[..., 0:5].sum() > 0
+    # quality-weighted channels carry mass wherever base counts do
+    assert ex.feats[..., 10:14].sum() > 0
+
+
+def test_v4_adapter_serves_and_gates(tmp_path):
+    """A 25-dim params tree routes the v4 feature path end-to-end (tile ->
+    pileup -> features -> logits -> splice), with and without quals."""
+    from ont_tcrconsensus_tpu.io import simulator
+    from ont_tcrconsensus_tpu.ops import consensus
+
+    params = polisher.init_params(0, feature_dim=polisher.FEATURE_DIM_V4)
+    assert polisher.params_feature_dim(params) == polisher.FEATURE_DIM_V4
+    rng = np.random.default_rng(11)
+    C, S, W = 2, 6, 256
+    sub = np.full((C, S, W), encode.PAD_CODE, np.uint8)
+    lens = np.zeros((C, S), np.int32)
+    quals = np.zeros((C, S, W), np.uint8)
+    strands = np.zeros((C, S), bool)
+    for c in range(C):
+        template = simulator._rand_seq(rng, 180)
+        template_rc = simulator.revcomp(template)
+        for i in range(S):
+            r, q, is_rev = train._simulate_oriented_read(
+                rng, template, template_rc, (0.01, 0.005, 0.005), None
+            )
+            sub[c, i, : len(r)] = r
+            quals[c, i, : len(q)] = q
+            lens[c, i] = len(r)
+            strands[c, i] = is_rev
+    drafts, dlens, final_pileup = consensus.consensus_clusters_batch(
+        sub, lens, rounds=6, band_width=consensus.POLISH_BAND_WIDTH,
+        keep_final_pileup=True,
+    )
+    assert final_pileup is not None and len(final_pileup) == 4
+    fn = polisher.make_pipeline_polisher(params)
+    # reuse path (pileup handed over) == recompute path, like the v1 test
+    out_fast, lens_fast = fn(sub, lens, drafts, dlens, pileup=final_pileup,
+                             quals=quals, strands=strands)
+    out_slow, lens_slow = fn(sub, lens, drafts, dlens,
+                             quals=quals, strands=strands)
+    np.testing.assert_array_equal(lens_fast, lens_slow)
+    np.testing.assert_array_equal(out_fast, out_slow)
+    # no quals at all (FASTA serving): QUAL_FILL stands in, still runs
+    out_nq, lens_nq = fn(sub, lens, drafts, dlens)
+    assert (np.asarray(lens_nq) > 0).all()
+
+
+def test_v4_weight_preference(tmp_path, monkeypatch):
+    """serving_weights_path prefers v4 > v3 > v2 among existing files."""
+    import os
+
+    monkeypatch.setattr(polisher, "_WEIGHTS_DIR", str(tmp_path))
+    monkeypatch.setattr(
+        polisher, "DEFAULT_WEIGHTS", str(tmp_path / "polisher_v2.msgpack")
+    )
+    monkeypatch.setattr(polisher, "_WEIGHT_PREFERENCE", (
+        str(tmp_path / "polisher_v4.msgpack"),
+        str(tmp_path / "polisher_v3.msgpack"),
+        str(tmp_path / "polisher_v2.msgpack"),
+    ))
+    polisher.save_params(polisher.init_params(0), tmp_path / "polisher_v2.msgpack")
+    assert os.path.basename(polisher.serving_weights_path()) == "polisher_v2.msgpack"
+    polisher.save_params(
+        polisher.init_params(0, feature_dim=polisher.FEATURE_DIM_V4),
+        tmp_path / "polisher_v4.msgpack",
+    )
+    # evidence gate: unevaluated v4 weights (no sibling _eval.json, e.g.
+    # written mid-training) must NOT flip the served generation
+    assert os.path.basename(polisher.serving_weights_path()) == "polisher_v2.msgpack"
+    (tmp_path / "polisher_v4_eval.json").write_text("{}")
+    assert os.path.basename(polisher.serving_weights_path()) == "polisher_v4.msgpack"
+    back = polisher.load_params(polisher.serving_weights_path())
+    assert polisher.params_feature_dim(back) == polisher.FEATURE_DIM_V4
+
+
+def test_sample_depth_lowdepth_distribution():
+    """lowdepth mode: ~70% of draws in 2-4 (the counts-contract regime),
+    the rest 5..max; bounds always respected, incl. a caller-narrowed
+    range (code-review r5)."""
+    rng = np.random.default_rng(0)
+    draws = [train.sample_depth(rng, (2, 8), "lowdepth") for _ in range(2000)]
+    assert min(draws) >= 2 and max(draws) <= 8
+    low = sum(d <= 4 for d in draws) / len(draws)
+    assert 0.6 < low < 0.8, low
+    # narrowed range excludes the low band entirely -> plain uniform
+    draws5 = [train.sample_depth(rng, (5, 8), "lowdepth") for _ in range(200)]
+    assert min(draws5) >= 5
+    # uniform mode ignores the band
+    draws_u = [train.sample_depth(rng, (2, 8), "uniform") for _ in range(200)]
+    assert min(draws_u) >= 2 and max(draws_u) <= 8

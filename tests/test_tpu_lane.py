@@ -146,7 +146,7 @@ for force_pallas in (False, True):
     got = pileup.pileup_columns_batch_auto(
         sub, lens, drafts, dlens, band_width=64, out_len=W,
         force_pallas=force_pallas)
-    for a, b, n in zip(ref, got, ("base_at", "ins_cnt", "ins_base", "spans")):
+    for a, b, n in zip(ref, got, ("base_at", "ins_cnt", "ins_base", "pos_at", "spans")):
         assert (np.asarray(a) == np.asarray(b)).all(), (force_pallas, n)
 print("PILEUP_OK")
 """)
