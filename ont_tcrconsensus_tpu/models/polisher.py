@@ -252,7 +252,9 @@ def _sharded_polish_from_pileup_v4(mesh):
 def make_pipeline_polisher(params, band_width: int | None = None,
                            min_confidence: float = 0.9,
                            min_polish_depth: int = 4,
-                           iterations: int = 1):
+                           iterations: int = 1,
+                           low_depth_params=None,
+                           low_depth: int = 2):
     """Adapter for ``stages.polish_clusters_all(polisher=...)``.
 
     Returns f(sub (C,S,W), lens (C,S), drafts (C,W), dlens (C,),
@@ -279,6 +281,17 @@ def make_pipeline_polisher(params, band_width: int | None = None,
     full pileup recompute — the model converges in one pass, so the
     default stays 1. The knob remains for future model generations whose
     confident fixes might compound.
+
+    ``low_depth_params``: optional weights for the depth-2 pass (the
+    v4-family strand+quality encoding; in production the bundled v4
+    generation serves here — a dedicated depth-2-only-trained specialist
+    tied it within noise, see LOW_DEPTH_WEIGHTS).
+    Clusters with EXACTLY ``low_depth`` live subreads — below the main
+    gate, where vote consensus fails the round-2 blast-id bar ~99% of the
+    time (weights/polisher_depth_gate_blastid.json) — get this model's
+    predictions instead of keeping the raw vote; all other clusters are
+    untouched. Both models share one pileup; the specialist costs one
+    extra RNN dispatch per tile only when such clusters exist.
     """
     from ont_tcrconsensus_tpu.ops.consensus import POLISH_BAND_WIDTH, QUAL_FILL
     from ont_tcrconsensus_tpu.ops.encode import PAD_CODE
@@ -287,6 +300,9 @@ def make_pipeline_polisher(params, band_width: int | None = None,
     # the weights generation decides the feature encoding: 25-dim params
     # serve pileup_features_v4 (strand + qual channels), 15-dim the v1 set
     wants_v4 = params_feature_dim(params) == FEATURE_DIM_V4
+    low_v4 = (low_depth_params is not None
+              and params_feature_dim(low_depth_params) == FEATURE_DIM_V4)
+    need_v4 = wants_v4 or low_v4
 
     def polish(sub, lens, drafts, dlens, pileup=None, band_width=None,
                mesh=None, quals=None, strands=None):
@@ -298,6 +314,17 @@ def make_pipeline_polisher(params, band_width: int | None = None,
             )
             pileup = None  # later passes re-pile vs the new draft
         return drafts, dlens
+
+    def _serve_from_pileup(p, v4, base_at, ins_cnt, ins_base, pos_at,
+                           drafts_d, quals, strands, mesh):
+        if v4:
+            fn = (_polish_from_pileup_v4_jit if mesh is None
+                  else _sharded_polish_from_pileup_v4(mesh))
+            return fn(p, base_at, ins_cnt, ins_base, pos_at, drafts_d,
+                      jnp.asarray(quals), jnp.asarray(strands))
+        fn = (_polish_from_pileup_jit if mesh is None
+              else _sharded_polish_from_pileup(mesh))
+        return fn(p, base_at, ins_cnt, ins_base, drafts_d)
 
     def _polish_once(sub, lens, drafts, dlens, pileup=None, band_width=None,
                      mesh=None, quals=None, strands=None):
@@ -312,28 +339,44 @@ def make_pipeline_polisher(params, band_width: int | None = None,
         training examples used, so it stays in-distribution."""
         if mesh is not None and np.asarray(drafts).shape[0] % mesh_data_size(mesh):
             mesh = None
-        if wants_v4:
+        live = (np.asarray(lens) > 0).sum(axis=1)
+        low_mask = (
+            (live == low_depth) if low_depth_params is not None
+            else np.zeros(live.shape, bool)
+        )
+        if need_v4:
             if quals is None:
                 quals = np.full(np.asarray(sub).shape, QUAL_FILL, np.uint8)
             if strands is None:
                 strands = np.zeros(np.asarray(lens).shape, bool)
-        if pileup is not None and wants_v4 and pileup[3] is None:
+        if pileup is not None and need_v4 and pileup[3] is None:
             # the consensus stage kept the pileup without its pos_at plane
             # (keep_pos=False); v4's quality channels need it -> recompute
             pileup = None
+        use_low = bool(low_mask.any())
+        if pileup is None and use_low:
+            # two models share ONE pileup: compute it unfused (the fused
+            # pileup+RNN dispatch below can only serve one params tree)
+            from ont_tcrconsensus_tpu.ops import pileup as pileup_mod
+
+            ba, ic, ib, pa, _ = pileup_mod.pileup_columns_batch_auto(
+                jnp.asarray(sub), jnp.asarray(lens), jnp.asarray(drafts),
+                jnp.asarray(dlens),
+                band_width=default_band if band_width is None else band_width,
+                out_len=np.asarray(drafts).shape[1], mesh=mesh,
+            )
+            pileup = (ba, ic, ib, pa)
         if pileup is not None:
             base_at, ins_cnt, ins_base, pos_at = pileup
-            if wants_v4:
-                fn = (_polish_from_pileup_v4_jit if mesh is None
-                      else _sharded_polish_from_pileup_v4(mesh))
-                out = fn(params, base_at, ins_cnt, ins_base, pos_at,
-                         jnp.asarray(drafts), jnp.asarray(quals),
-                         jnp.asarray(strands))
-            else:
-                fn = (_polish_from_pileup_jit if mesh is None
-                      else _sharded_polish_from_pileup(mesh))
-                out = fn(params, base_at, ins_cnt, ins_base,
-                         jnp.asarray(drafts))
+            out = _serve_from_pileup(
+                params, wants_v4, base_at, ins_cnt, ins_base, pos_at,
+                jnp.asarray(drafts), quals, strands, mesh,
+            )
+            if use_low:
+                out_low = _serve_from_pileup(
+                    low_depth_params, low_v4, base_at, ins_cnt, ins_base,
+                    pos_at, jnp.asarray(drafts), quals, strands, mesh,
+                )
         elif mesh is not None:
             out = _device_polish_batch(
                 params, jnp.asarray(sub), jnp.asarray(lens),
@@ -352,6 +395,20 @@ def make_pipeline_polisher(params, band_width: int | None = None,
                 is_rev=jnp.asarray(strands) if wants_v4 else None,
             )
         pred, conf, depth, ins_pred, ins_conf = jax.device_get(out)
+        if use_low:
+            # the depth-2 specialist's predictions replace the main
+            # model's ONLY on exactly-low_depth clusters (blast-id
+            # evidence: weights/polisher_depth_gate_blastid.json — vote
+            # fails the 0.99 bar ~99% there, the v4-family specialist
+            # recovers a real fraction; depth>=3 vote already passes, so
+            # the pass cannot touch any other cluster)
+            (pred_l, conf_l, _depth_l, ins_pred_l,
+             ins_conf_l) = jax.device_get(out_low)
+            m = low_mask[:, None]
+            pred = np.where(m, pred_l, pred)
+            conf = np.where(m, conf_l, conf)
+            ins_pred = np.where(m, ins_pred_l, ins_pred)
+            ins_conf = np.where(m, ins_conf_l, ins_conf)
         drafts = np.asarray(drafts)
         dlens = np.asarray(dlens)
         C, W = drafts.shape
@@ -359,9 +416,7 @@ def make_pipeline_polisher(params, band_width: int | None = None,
         out = np.full_like(drafts, PAD_CODE)
         out_lens = np.zeros_like(dlens)
         in_draft = pos[None, :] < dlens[:, None]
-        deep_enough = (
-            (np.asarray(lens) > 0).sum(axis=1) >= min_polish_depth
-        )[:, None]
+        deep_enough = (live >= min_polish_depth)[:, None] | low_mask[:, None]
         covered = in_draft & (depth > 0) & deep_enough
         apply = covered & (conf >= min_confidence)
         base = np.where(apply, pred, drafts)
@@ -384,8 +439,9 @@ def make_pipeline_polisher(params, band_width: int | None = None,
         return out, out_lens
 
     # the polish stage keys keep_pos (whether the consensus rounds retain
-    # the pos_at plane for the v4 quality channels) off this attribute
-    polish.wants_v4 = wants_v4
+    # the pos_at plane for the v4 quality channels) off this attribute;
+    # the low-depth specialist is v4-family, so it needs pos_at too
+    polish.wants_v4 = need_v4
     return polish
 
 
@@ -484,4 +540,27 @@ def load_default_params() -> dict | None:
     path = serving_weights_path()
     if os.path.exists(path):
         return load_params(path)
+    return None
+
+
+# The low-depth (exactly-2-subread) pass serves the v4 generation: its
+# strand+quality channels are the right instrument precisely where two
+# disagreeing reads leave quality as the only arbiter (it lost the MAIN
+# serving slot on held-out exactness at depth>=4, but at depth 2 it cuts
+# the vote's ~99% blast-id-bar failure rate to ~80-86%; a dedicated
+# depth-2-only-trained specialist ties it within noise — both measured in
+# the evidence artifact below).
+LOW_DEPTH_WEIGHTS = os.path.join(_WEIGHTS_DIR, "polisher_v4.msgpack")
+LOW_DEPTH_EVIDENCE = os.path.join(
+    _WEIGHTS_DIR, "polisher_depth_gate_blastid.json"
+)
+
+
+def load_low_depth_params() -> dict | None:
+    """Weights for the exactly-depth-2 polish pass, or None.
+
+    Same evidence-gate discipline as the main generations: served only
+    when the blast-id evidence artifact exists alongside the weights."""
+    if os.path.exists(LOW_DEPTH_WEIGHTS) and os.path.exists(LOW_DEPTH_EVIDENCE):
+        return load_params(LOW_DEPTH_WEIGHTS)
     return None

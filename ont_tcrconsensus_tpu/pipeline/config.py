@@ -117,6 +117,14 @@ class RunConfig:
     #   consensus; the per-regime depth-3 tradeoff (fixed vs broke) is
     #   measured in models/weights/polisher_v3_eval.json — lower to 3 when
     #   the bundled weights' eval shows fixed >> broke there
+    # Depth-2 polish pass below the gate: exactly-2-subread clusters' vote
+    # consensus fails the round-2 blast-id bar ~99% of the time and the
+    # v4-family weights recover a measured fraction (evidence:
+    # models/weights/polisher_depth_gate_blastid.json); cannot touch any
+    # other cluster. Structurally inert unless min_reads_per_cluster <= 2
+    # (selection never emits 2-member clusters otherwise), and run.py only
+    # pays its costs when it can actually fire.
+    low_depth_polish: bool = True
 
     # --- TPU execution (new; no reference analogue) ---
     hbm_budget_gb: float | None = None  # None -> detect chip HBM (the one

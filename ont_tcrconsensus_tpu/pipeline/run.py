@@ -152,8 +152,17 @@ def _run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]
 
         params = polisher_mod.load_default_params()
         if params is not None:
+            # only load (and pay pos_at retention for) the depth-2 pass
+            # when selection can actually emit 2-member clusters — under
+            # min_reads_per_cluster > 2 it is structurally dead
+            low_params = (
+                polisher_mod.load_low_depth_params()
+                if cfg.low_depth_polish and cfg.min_reads_per_cluster <= 2
+                else None
+            )
             polisher = polisher_mod.make_pipeline_polisher(
-                params, min_polish_depth=cfg.min_polish_depth
+                params, min_polish_depth=cfg.min_polish_depth,
+                low_depth_params=low_params,
             )
         else:
             _log("polish_method=rnn but no bundled weights; using vote consensus only")

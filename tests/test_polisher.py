@@ -322,3 +322,55 @@ def test_sample_depth_lowdepth_distribution():
     # uniform mode ignores the band
     draws_u = [train.sample_depth(rng, (2, 8), "uniform") for _ in range(200)]
     assert min(draws_u) >= 2 and max(draws_u) <= 8
+
+
+def test_low_depth_specialist_pass_scope():
+    """The depth-2 specialist must touch ONLY exactly-low_depth clusters:
+    depth-3 (below the main gate) keeps the vote consensus verbatim, and
+    deep clusters keep the main model's behavior with or without the
+    specialist wired."""
+    from ont_tcrconsensus_tpu.io import simulator
+    from ont_tcrconsensus_tpu.ops import consensus
+
+    main = polisher.init_params(0)  # 15-dim v1 main model
+    low = polisher.init_params(1, feature_dim=polisher.FEATURE_DIM_V4)
+    rng = np.random.default_rng(21)
+    C, S, W = 3, 6, 256
+    sub = np.full((C, S, W), encode.PAD_CODE, np.uint8)
+    lens = np.zeros((C, S), np.int32)
+    depths = [2, 3, 6]  # below-low, between, above-gate
+    for c in range(C):
+        template = simulator._rand_seq(rng, 180)
+        for i in range(depths[c]):
+            s, _ = simulator.mutate(rng, template, 0.02, 0.01, 0.01)
+            e = encode.encode_seq(s)
+            sub[c, i, : len(e)] = e
+            lens[c, i] = len(e)
+    drafts, dlens = consensus.consensus_clusters_batch(sub, lens)
+    drafts, dlens = np.asarray(drafts), np.asarray(dlens)
+
+    plain = polisher.make_pipeline_polisher(main, min_polish_depth=4)
+    with_low = polisher.make_pipeline_polisher(
+        main, min_polish_depth=4, low_depth_params=low
+    )
+    assert with_low.wants_v4  # specialist needs pos_at retained
+    o_p, l_p = plain(sub, lens, drafts, dlens)
+    o_l, l_l = with_low(sub, lens, drafts, dlens)
+    # depth-3: below both the gate and the specialist -> identical vote
+    np.testing.assert_array_equal(o_p[1], o_l[1])
+    np.testing.assert_array_equal(o_p[2], o_l[2])  # deep: main model both
+    # depth-2 with the plain adapter: untouched vote consensus
+    assert l_p[0] == dlens[0] and (o_p[0, : l_p[0]] == drafts[0, : dlens[0]]).all()
+    # POSITIVE proof the pass can fire (a regression that silently kills
+    # low_mask would otherwise go unnoticed — code-review r5): with the
+    # confidence gate dropped, an untrained specialist's argmax output
+    # must actually change the depth-2 cluster, and only that cluster
+    eager = polisher.make_pipeline_polisher(
+        main, min_polish_depth=4, low_depth_params=low, min_confidence=0.0
+    )
+    o_e, l_e = eager(sub, lens, drafts, dlens)
+    changed = not (
+        l_e[0] == dlens[0] and (o_e[0, : l_e[0]] == drafts[0, : dlens[0]]).all()
+    )
+    assert changed, "depth-2 specialist never fired"
+    np.testing.assert_array_equal(o_e[1], o_p[1])  # depth-3 still vote
