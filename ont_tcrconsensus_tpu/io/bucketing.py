@@ -108,9 +108,10 @@ def _rows_to_batch(
     """Materialize one padded batch from (codes, quals|None, name) rows.
 
     THE single place that owns the padded-batch policy (pow2-of-real-count
-    floor 64, PAD_CODE fill, qual filler 93, ''-padded ids) for the
+    floor 64, PAD_CODE fill, QUAL_FILL qual filler, ''-padded ids) for the
     columnar ingest paths — batch_parsed_reads and batch_parsed_chunks
-    must stay shape-identical on the same data.
+    must stay byte-identical with the record path (_make_batch) on the
+    same data (tests/test_native.py pins this).
 
     A final partial batch pads to the pow2 of its REAL count (floor 64
     keeps mesh divisibility and compile classes bounded): the round-2
@@ -119,7 +120,12 @@ def _rows_to_batch(
     """
     B = min(batch_size, pow2_ceil(len(rows), 64))
     codes = np.full((B, w), encode.PAD_CODE, dtype=np.uint8)
-    quals = np.full((B, w), 93, dtype=np.uint8) if has_quals else None
+    if has_quals:
+        from ont_tcrconsensus_tpu.ops.consensus import QUAL_FILL
+
+        quals = np.full((B, w), QUAL_FILL, dtype=np.uint8)
+    else:
+        quals = None
     blens = np.zeros((B,), dtype=np.int32)
     valid = np.zeros((B,), dtype=bool)
     ids: list[str] = []

@@ -137,6 +137,10 @@ class RunConfig:
     round2_targeted_assign: bool = True  # align consensus only against its
     #   round-1 region cluster's refs (skip sketch/strand re-derivation);
     #   False restores the full fused pass for round 2
+    round1_fast_assign: bool = True   # SW only the needy quarter of each
+    #   round-1 batch (sketch-confident reads synthesize their filter
+    #   inputs — assign.py fast path, DIVERGENCES #12); False restores
+    #   full-batch SW in round 1
     mesh_shape: dict[str, int] | None = None  # e.g. {"data": 8}
     distributed: bool = False         # multi-host: jax.distributed init +
     #   shard-by-barcode across processes (parallel/distributed.py)

@@ -234,6 +234,7 @@ def _run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]
         primer_max_dist_frac=cfg.primer_max_dist_frac,
         a5=cfg.max_softclip_5_end, a3=cfg.max_softclip_3_end,
         trim_window=cfg.trim_window, band_width=cfg.sw_band_width, mesh=mesh,
+        fast_denom=4 if cfg.round1_fast_assign else 0,
     )
     # round 2 aligns already-trimmed consensus sequences: no primer search
     engine_notrim = stages.AssignEngine(

@@ -283,6 +283,14 @@ def profile_store(store, panel, sample_size: int = 1000, seed: int = 0,
     """
     from ont_tcrconsensus_tpu.ops import encode
 
+    # Uniform sample over ALL survivors — restricting to SW-verified rows
+    # would bias the profile toward the need-ranked hard quarter
+    # (code-review r5 finding #2). Fast-path rows carry synthesized ref
+    # spans (exact up to net indel drift, <2% of the region — assign.py
+    # DIVERGENCES #12); the cs tags come from THIS function's own
+    # re-alignment, so the span only slices the reference and the drift
+    # adds edge noise far below the selection bias it replaces. Their
+    # blast-id is NaN and is excluded from the blast histogram below.
     handles = [
         (bi, r) for bi, blk in enumerate(store.blocks) for r in range(blk.num_reads)
     ]
@@ -314,7 +322,9 @@ def profile_store(store, panel, sample_size: int = 1000, seed: int = 0,
             ridx = int(blk.region_idx[r])
             tag_counter[tag] += 1
             tag_region[tag][panel.names[ridx]] += 1
-            tag_blast[tag][round(float(blk.blast_id[r]), 6)] += 1
+            b = float(blk.blast_id[r])
+            if not np.isnan(b):
+                tag_blast[tag][round(b, 6)] += 1
     return tag_counter, tag_region, tag_blast
 
 

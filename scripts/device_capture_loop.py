@@ -70,7 +70,7 @@ def log_line(text: str) -> None:
 
 
 def kernel_done(*names: str) -> bool:
-    names = names or ("sw", "pileup", "rnn", "fused")
+    names = names or ("sw", "pileup", "rnn", "fused", "fused_fast")
     try:
         with open(KERNEL_OUT) as fh:
             rep = json.load(fh)
