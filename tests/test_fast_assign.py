@@ -121,6 +121,48 @@ def test_fast_vs_exact_same_survivors_and_outputs():
     assert flat(store_fast) == flat(store_exact)
 
 
+def test_sharded_fast_path_matches_single_device():
+    """shard_map fast path over the 8-device mesh produces the same filter
+    DECISIONS as the single-device fast path. The SW subset is selected
+    per shard (top-k over each shard's rows), so sw_done/spans/raw scores
+    legitimately differ between mesh layouts — what must agree is
+    everything the host filters on: region pick, trim frame, gates, UMI
+    locations."""
+    import jax
+    from jax.sharding import Mesh
+
+    from ont_tcrconsensus_tpu.io import bucketing
+
+    lib = _library(seed=29)
+    panel = _panel(lib)
+    recs = [
+        fastx.FastxRecord(h.split()[0], "", s, q) for h, s, q in lib.reads
+    ]
+    recs = (recs * 4)[:256]
+    batch = next(bucketing.batch_reads(recs, batch_size=256, widths=(2048,)))
+
+    kw = dict(primers=[], fast_denom=4)
+    eng1 = A.AssignEngine(panel, UMI_FWD, UMI_REV, **kw)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    eng8 = A.AssignEngine(panel, UMI_FWD, UMI_REV, mesh=mesh, **kw)
+
+    out1 = eng1.run_batch(batch, 0.07, 900, overlap_frac=0.95)
+    out8 = eng8.run_batch(batch, 0.07, 900, overlap_frac=0.95)
+    assert set(out1) == set(out8)
+    # both layouts ran the subset fast path, not a degenerate full-SW
+    assert 0 < int(out1["sw_done"].sum()) < len(out1["sw_done"])
+    assert 0 < int(out8["sw_done"].sum()) < len(out8["sw_done"])
+    for k in ("ridx", "lens", "t_start", "ee_ok", "is_rev",
+              "d5", "s5", "e5", "d3", "s3", "e3", "start3"):
+        np.testing.assert_array_equal(
+            np.asarray(out1[k]), np.asarray(out8[k]), err_msg=k
+        )
+    np.testing.assert_array_equal(
+        np.asarray(out1["score"]) >= A.MIN_SCORE,
+        np.asarray(out8["score"]) >= A.MIN_SCORE,
+    )
+
+
 def test_sw_done_mask_and_error_profile_sampling():
     lib = _library(seed=17)
     panel = _panel(lib)
