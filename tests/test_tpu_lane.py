@@ -24,13 +24,45 @@ pytestmark = pytest.mark.tpu
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _device_env() -> dict:
+    """Subprocess env that can see the real chip.
+
+    The conftest pins the in-process jax to CPU via jax.config (os.environ
+    still carries the launch platform, e.g. JAX_PLATFORMS=axon for the TPU
+    tunnel). Experimental platforms are only enabled when explicitly
+    requested, so the var must be KEPT for the subprocess — dropping it
+    makes jax fall back to CPU and the lane self-skips with a live chip.
+    Only an explicit CPU pin is stripped so discovery can run.
+    """
+    env = dict(os.environ)
+    if env.get("JAX_PLATFORMS", "").strip().lower().startswith("cpu"):
+        del env["JAX_PLATFORMS"]
+    # The conftest's virtual-CPU-mesh flag breaks the tunnel plugin's
+    # backend registration in a child process; it is CPU-suite-only.
+    flags = [
+        tok for tok in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in tok
+    ]
+    if flags:
+        env["XLA_FLAGS"] = " ".join(flags)
+    else:
+        env.pop("XLA_FLAGS", None)
+    # PREPEND the repo: the launch environment delivers the TPU tunnel's
+    # jax plugin via PYTHONPATH, so overwriting the var severs the child
+    # from the chip entirely (the r4 lane skips were exactly this).
+    inherited = env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = (
+        _REPO + os.pathsep + inherited if inherited else _REPO
+    )
+    return env
+
+
 def _tpu_present() -> bool:
     probe = (
         "import jax, json; "
         "print(json.dumps([d.platform for d in jax.devices()]))"
     )
-    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
-    env["PYTHONPATH"] = _REPO
+    env = _device_env()
     try:
         out = subprocess.run(
             [sys.executable, "-c", probe], capture_output=True, text=True,
@@ -45,8 +77,7 @@ def _tpu_present() -> bool:
 
 
 def _run_on_tpu(code: str, timeout: int = 600) -> str:
-    env = {k: v for k, v in os.environ.items() if k not in ("JAX_PLATFORMS",)}
-    env["PYTHONPATH"] = _REPO
+    env = _device_env()
     res = subprocess.run(
         [sys.executable, "-c", code], capture_output=True, text=True,
         timeout=timeout, env=env, cwd=_REPO,
@@ -157,7 +188,14 @@ print("PILEUP_OK")
 def test_targeted_round2_pass_on_tpu():
     """The round-2 targeted pass (Pallas SW against per-read candidate
     refs) must agree with the full fused pass's assignment on the real
-    chip — same survivors, same regions, same blast-ids."""
+    chip — same survivors, same regions, same blast-ids.
+
+    The targeted pass's input contract is molecule-(+)-oriented sequence
+    (the polish path orients subreads before the vote; assign.py
+    _targeted_pass docstring), so minus-strand reads are oriented with
+    the fused pass's strand call first — feeding raw reads puts the true
+    diagonal outside the band and the pass rightly scores ~0 (this test's
+    first on-chip run caught exactly that misuse)."""
     out = _run_on_tpu(r"""
 import numpy as np
 from ont_tcrconsensus_tpu.io import bucketing, fastx, simulator
@@ -175,15 +213,23 @@ eng = assign.AssignEngine(panel, cfg.umi_fwd, cfg.umi_rev, primers=[])
 recs = [fastx.FastxRecord(h.split()[0], "", s, None) for h, s, _ in lib.reads]
 batch = next(bucketing.batch_reads(recs, batch_size=64, with_quals=False))
 full = eng.run_batch(batch, max_ee_rate=1.0, min_len=1)
-cand = np.full((len(batch.ids), 1), -1, np.int32)
-cand[batch.valid, 0] = full["ridx"][batch.valid]
-tgt = eng.run_batch_targeted_async(batch, cand, min_len=1)
+comp = str.maketrans("ACGT", "TGCA")
+oriented = [
+    fastx.FastxRecord(
+        r.name, "",
+        r.sequence.translate(comp)[::-1] if rev else r.sequence, None)
+    for r, rev in zip(recs, full["is_rev"][batch.valid])
+]
+obatch = next(bucketing.batch_reads(oriented, batch_size=64, with_quals=False))
+cand = np.full((len(obatch.ids), 1), -1, np.int32)
+cand[obatch.valid, 0] = full["ridx"][batch.valid]
+tgt = eng.run_batch_targeted_async(obatch, cand, min_len=1)
 import jax
 tgt = jax.device_get(tgt)
-v = batch.valid
-assert (tgt["ridx"][v] == full["ridx"][v]).all()
-assert (np.abs(tgt["blast_id"][v] - full["blast_id"][v]) < 1e-6).all()
-assert (tgt["score"][v] == full["score"][v]).all()
+v = obatch.valid
+assert (tgt["ridx"][v] == full["ridx"][batch.valid]).all()
+assert (np.abs(tgt["blast_id"][v] - full["blast_id"][batch.valid]) < 1e-6).all()
+assert (tgt["score"][v] == full["score"][batch.valid]).all()
 print("TARGETED_OK")
 """)
     assert "TARGETED_OK" in out
