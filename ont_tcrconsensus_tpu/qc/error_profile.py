@@ -20,8 +20,11 @@ distribution, not base-perfect minimap2 score parity, is the artifact.
 
 from __future__ import annotations
 
+import functools
 from collections import Counter, defaultdict
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 _BASE = "acgtn"  # cs syntax is lowercase
@@ -270,6 +273,233 @@ def banded_cs_batch(queries: list[np.ndarray], refs: list[np.ndarray],
     return [s if s is not None else "" for s in out]
 
 
+# ---------------------------------------------------------------------------
+# device cs path (the on-chip bench made the QC stage the largest block:
+# 26.5s of a 59.4s timed run at 512+667 profiled sequences — the host numpy
+# fill walks ~2.3k sequential rows per chunk and the python traceback ~2.6k
+# steps per read; BENCH_TPU_CAPTURE_FULL.json.stderr.log).  The fill and the
+# traceback both run as lax.scan on the accelerator; only a compact per-step
+# op log (kind + the two base codes) returns to host, where the cs string is
+# assembled per contiguous segment instead of per base.  Output is
+# bit-identical to banded_cs_batch (asserted by tests/test_qc.py).
+
+_K_MATCH, _K_SUB, _K_INS, _K_DEL, _K_STOP = 0, 1, 2, 3, 4
+
+
+@functools.partial(jax.jit, static_argnames=("w_pad",))
+def _device_cs_core(qpad, rpad, n_arr, m_arr, lo_all, ws, *, w_pad):
+    """Banded unit-cost DP fill + traceback on device.
+
+    Args: qpad (L,N) int16, rpad (L,M) int16, n_arr/m_arr (L,) int32,
+    lo_all (L, N+1) int32 per-row band starts, ws (L,) int32 per-read band
+    widths; w_pad static >= ws.max().  Returns (kind, qb, rb): (S, L)
+    uint8 step logs in TRACEBACK (reverse) order, kind==_K_STOP past the
+    walk's end.  Semantics mirror banded_cs_batch row by row: ties prefer
+    diagonal over up, a strict `<` lets the left chain win, and a
+    fallen-off-band walk stops with the conservative tail.
+    """
+    L, N = qpad.shape
+    M = rpad.shape[1]
+    BIG = jnp.int32(1 << 20)
+    lanes = jnp.arange(w_pad, dtype=jnp.int32)[None, :]
+    lane_ok = lanes < ws[:, None]
+
+    js0 = lo_all[:, 0:1] + lanes
+    valid0 = lane_ok & (js0 <= m_arr[:, None])
+    prev0 = jnp.where(valid0, js0, BIG).astype(jnp.int32)
+    ptr0 = jnp.where(valid0, jnp.uint8(2), jnp.uint8(0))
+
+    def fill_row(prev, i):
+        nlo = jax.lax.dynamic_slice_in_dim(lo_all, i, 1, axis=1)[:, 0]
+        plo = jax.lax.dynamic_slice_in_dim(lo_all, i - 1, 1, axis=1)[:, 0]
+        alive = i <= n_arr
+        shift = nlo - plo
+        src = lanes + shift[:, None] - 1
+        okm = (src >= 0) & (src < w_pad)
+        diag = jnp.where(
+            okm, jnp.take_along_axis(prev, jnp.clip(src, 0, w_pad - 1), 1), BIG
+        )
+        src_up = src + 1
+        oku = (src_up >= 0) & (src_up < w_pad)
+        up = jnp.where(
+            oku, jnp.take_along_axis(prev, jnp.clip(src_up, 0, w_pad - 1), 1),
+            BIG,
+        )
+        js = nlo[:, None] + lanes
+        valid = lane_ok & (js <= m_arr[:, None]) & alive[:, None]
+        qi = jnp.take_along_axis(
+            qpad, jnp.clip(jnp.minimum(i, n_arr) - 1, 0, N - 1)[:, None], 1
+        ).astype(jnp.int32)
+        rj = jnp.take_along_axis(
+            rpad, jnp.clip(js - 1, 0, M - 1), 1
+        ).astype(jnp.int32)
+        sub = jnp.where((rj == qi) & (qi < 4) & (rj < 4), 0, 1)
+        d = jnp.where(js >= 1, diag + sub, BIG)
+        u = up + 1
+        best = jnp.minimum(d, u)
+        p = jnp.where(u < d, jnp.uint8(1), jnp.uint8(0))
+        best = jnp.where(valid, best, BIG)
+        run_min = jax.lax.cummin(best - lanes, axis=1)
+        left = jnp.take_along_axis(run_min, jnp.maximum(lanes - 1, 0), 1) + lanes
+        left = left.at[:, 0].set(BIG)
+        take_left = (left < best) & valid
+        best = jnp.where(take_left, left, best)
+        p = jnp.where(take_left, jnp.uint8(2), p)
+        cur = jnp.where(valid, best, BIG).astype(jnp.int32)
+        prow = jnp.where(valid, p, jnp.uint8(0))
+        return jnp.where(alive[:, None], cur, prev), prow
+
+    _, ptr_rows = jax.lax.scan(
+        fill_row, prev0, jnp.arange(1, N + 1, dtype=jnp.int32)
+    )
+    ptr = jnp.concatenate([ptr0[None], ptr_rows], axis=0)  # (N+1, L, W)
+    ptr_flat = ptr.reshape(-1)
+    row_stride = jnp.int32(L * w_pad)
+    read_off = jnp.arange(L, dtype=jnp.int32) * w_pad
+
+    def tb_step(carry, _):
+        i, j, done = carry
+        lo_i = jnp.take_along_axis(lo_all, jnp.clip(i, 0, N)[:, None], 1)[:, 0]
+        t = j - lo_i
+        in_band = (t >= 0) & (t < ws)
+        walking = ((i > 0) | (j > 0)) & ~done
+        stop_now = walking & ~in_band  # fell off the band -> bail
+        act = walking & in_band
+        tc = jnp.clip(t, 0, w_pad - 1)
+        p = jnp.take(ptr_flat, i * row_stride + read_off + tc)
+        qc = jnp.take_along_axis(
+            qpad, jnp.clip(i - 1, 0, N - 1)[:, None], 1
+        )[:, 0].astype(jnp.uint8)
+        rc = jnp.take_along_axis(
+            rpad, jnp.clip(j - 1, 0, M - 1)[:, None], 1
+        )[:, 0].astype(jnp.uint8)
+        is_diag = (i > 0) & (j > 0) & (p == 0)
+        is_up = ~is_diag & (i > 0) & (p == 1)
+        is_left = ~is_diag & ~is_up & (j > 0)
+        # residual: i > 0, j == 0, p != 1 -> query insertion (the python
+        # walk's final else branch)
+        is_tail_ins = ~is_diag & ~is_up & ~is_left
+        kind = jnp.where(
+            is_diag,
+            jnp.where((qc == rc) & (qc < 4), jnp.uint8(_K_MATCH),
+                      jnp.uint8(_K_SUB)),
+            jnp.where(is_up | is_tail_ins, jnp.uint8(_K_INS),
+                      jnp.uint8(_K_DEL)),
+        )
+        kind = jnp.where(act, kind, jnp.uint8(_K_STOP))
+        di = jnp.where(is_diag | is_up | is_tail_ins, 1, 0)
+        dj = jnp.where(is_diag | is_left, 1, 0)
+        i = jnp.where(act, i - di, i)
+        j = jnp.where(act, j - dj, j)
+        done = done | stop_now | ((i == 0) & (j == 0))
+        return (i, j, done), (kind, qc, rc)
+
+    (_, _, _), (kind, qb, rb) = jax.lax.scan(
+        tb_step, (n_arr, m_arr, jnp.zeros((L,), bool)), None, length=N + M
+    )
+    return kind, qb, rb
+
+
+def _cs_from_oplog(kind: np.ndarray, qb: np.ndarray, rb: np.ndarray) -> str:
+    """cs string from ONE read's reverse-order op log (1-D arrays)."""
+    stop = np.flatnonzero(kind == _K_STOP)
+    end = int(stop[0]) if stop.size else kind.size
+    k = kind[:end][::-1]
+    q = qb[:end][::-1]
+    r = rb[:end][::-1]
+    if end == 0:
+        return ""
+    bounds = np.flatnonzero(np.diff(k)) + 1
+    out: list[str] = []
+    start = 0
+    for stop_ in list(bounds) + [end]:
+        seg_kind = int(k[start])
+        ln = stop_ - start
+        if seg_kind == _K_MATCH:
+            out.append(f":{ln}")
+        elif seg_kind == _K_SUB:
+            out.append("".join(
+                f"*{_BASE[r[s]]}{_BASE[q[s]]}" for s in range(start, stop_)
+            ))
+        elif seg_kind == _K_INS:
+            out.append("+" + "".join(_BASE[c] for c in q[start:stop_]))
+        else:
+            out.append("-" + "".join(_BASE[c] for c in r[start:stop_]))
+        start = stop_
+    return "".join(out)
+
+
+def banded_cs_batch_device(queries: list[np.ndarray], refs: list[np.ndarray],
+                           band: int = 96, tile: int = 512) -> list[str]:
+    """Device twin of :func:`banded_cs_batch` (bit-identical output).
+
+    The degenerate-row and band-outlier fallbacks reuse the host paths
+    verbatim; live reads run the jitted fill+traceback in fixed-shape
+    tiles (lengths bucketed to 256, band lanes to 64) so the persistent
+    compile cache holds a handful of variants across chunk geometries.
+    """
+    B = len(queries)
+    if B == 0:
+        return []
+    qs = [np.asarray(q, dtype=np.int16) for q in queries]
+    rs = [np.asarray(r, dtype=np.int16) for r in refs]
+    ns = np.array([len(q) for q in qs], np.int32)
+    ms = np.array([len(r) for r in rs], np.int32)
+    out: list[str | None] = [None] * B
+    halves_all = np.maximum(band // 2, np.abs(ns - ms) + 8)
+    w_cap = 2 * max(band // 2, 128) + 1
+    live = []
+    for b in range(B):
+        if ns[b] == 0:
+            out[b] = f"-{''.join(_BASE[c] for c in rs[b])}" if ms[b] else ""
+        elif ms[b] == 0:
+            out[b] = f"+{''.join(_BASE[c] for c in qs[b])}"
+        elif 2 * halves_all[b] + 1 > w_cap:
+            out[b] = banded_cs(qs[b], rs[b], band=band)  # band outlier
+        else:
+            live.append(b)
+
+    def bucket(x: int, q: int) -> int:
+        return -(-x // q) * q
+
+    for s in range(0, len(live), tile):
+        part = live[s : s + tile]
+        L = len(part)
+        n_arr = ns[part]
+        m_arr = ms[part]
+        halves = halves_all[part]
+        ws = 2 * halves + 1
+        N = bucket(int(n_arr.max()), 256)
+        M = bucket(int(m_arr.max()), 256)
+        w_pad = bucket(int(ws.max()), 64)
+        L_pad = bucket(L, 64)
+        qpad = np.zeros((L_pad, N), np.int16)
+        rpad = np.zeros((L_pad, M), np.int16)
+        for k, b in enumerate(part):
+            qpad[k, : ns[b]] = qs[b]
+            rpad[k, : ms[b]] = rs[b]
+        n_full = np.ones(L_pad, np.int32)  # pad rows: 1-base walks, discarded
+        m_full = np.ones(L_pad, np.int32)
+        n_full[:L] = n_arr
+        m_full[:L] = m_arr
+        ws_full = np.full(L_pad, ws.max() if L else 1, np.int32)
+        ws_full[:L] = ws
+        rows = np.arange(N + 1, dtype=np.int32)[None, :]
+        centers = np.rint(rows * m_full[:, None] / n_full[:, None]).astype(np.int32)
+        halves_full = np.ones(L_pad, np.int32)
+        halves_full[:L] = halves
+        lo_all = np.clip(centers - halves_full[:, None], 0, None)
+        lo_all = np.minimum(lo_all, m_full[:, None])
+        kind, qb, rb = jax.device_get(_device_cs_core(
+            jnp.asarray(qpad), jnp.asarray(rpad), jnp.asarray(n_full),
+            jnp.asarray(m_full), jnp.asarray(lo_all), jnp.asarray(ws_full),
+            w_pad=w_pad,
+        ))
+        for k, b in enumerate(part):
+            out[b] = _cs_from_oplog(kind[:, k], qb[:, k], rb[:, k])
+    return [s_ if s_ is not None else "" for s_ in out]
+
+
 def profile_store(store, panel, sample_size: int = 1000, seed: int = 0,
                   chunk: int = 1024):
     """cs-tag counters over a read-store sample.
@@ -316,7 +546,13 @@ def profile_store(store, panel, sample_size: int = 1000, seed: int = 0,
             ridx = int(blk.region_idx[r])
             rs, re = int(blk.ref_start[r]), int(blk.ref_end[r])
             ref_spans.append(panel.codes[ridx, rs:re])
-        tags = banded_cs_batch(queries, ref_spans)
+        # accelerator backends run the jitted fill+traceback (bit-identical;
+        # the QC pass was the largest stage of the first on-chip bench);
+        # host CPU keeps the numpy fill, which wins there at test shapes
+        if jax.default_backend() != "cpu":
+            tags = banded_cs_batch_device(queries, ref_spans)
+        else:
+            tags = banded_cs_batch(queries, ref_spans)
         for (bi, r), tag in zip(part, tags):
             blk = store.blocks[bi]
             ridx = int(blk.region_idx[r])

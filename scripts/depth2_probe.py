@@ -1,0 +1,230 @@
+"""Depth-2 residual error composition probe (VERDICT r4 #2 follow-through).
+
+LANE_SCALE_R5.md leaves 121/8000 heavy-region molecules uncounted, all in
+the depth-2 chain: clusters attrited to exactly 2 effective reads whose
+polished consensus still fails the round-2 blast-id > 0.99 bar. Before
+building anything, this probe measures WHAT the surviving errors are, on
+the same simulator regime the lane proof uses:
+
+- per-cluster error count vs the ~1%-of-length budget the bar implies;
+- per-error class (sub / del / ins, from the cs-tag vs truth);
+- homopolymer context (inside or adjacent to a truth run >= 3);
+- subread evidence at the error column (pileup base_at): did the two
+  reads AGREE on the wrong base (correlated error — only a learned prior
+  can fix it) or DISAGREE (arbitration loss — a better tie-break rule or
+  richer features can fix it)?
+
+The split drives the next move: majority-disagreement -> engineer the
+depth-2 merge; majority-correlated -> train for the prior (or accept the
+bound and document it, as medaka-at-depth-2 accepts its own).
+
+Run (CPU fine, ~150 clusters):
+    python scripts/depth2_probe.py [--n 150] [--out DEPTH2_PROBE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from ont_tcrconsensus_tpu.io import simulator  # noqa: E402
+from ont_tcrconsensus_tpu.models import polisher, train  # noqa: E402
+from ont_tcrconsensus_tpu.ops import consensus, encode  # noqa: E402
+from ont_tcrconsensus_tpu.qc.error_profile import banded_cs  # noqa: E402
+
+BLAST_BAR = 0.99
+
+
+def hp_mask(truth: np.ndarray, min_run: int = 3) -> np.ndarray:
+    """True where the truth base sits inside (or borders) a run >= min_run."""
+    n = truth.size
+    mask = np.zeros(n, bool)
+    i = 0
+    while i < n:
+        j = i
+        while j < n and truth[j] == truth[i]:
+            j += 1
+        if j - i >= min_run:
+            mask[max(i - 1, 0): min(j + 1, n)] = True
+        i = j
+    return mask
+
+
+def parse_cs(cs: str):
+    """Yield (op, ref_pos, length) per difference; ops: sub/del/ins.
+
+    ref_pos is the truth coordinate where the difference applies (for an
+    insertion: the truth position it precedes).
+    """
+    pos = 0
+    for m in re.finditer(r":(\d+)|\*([a-z])([a-z])|\+([a-z]+)|-([a-z]+)", cs):
+        if m.group(1):
+            pos += int(m.group(1))
+        elif m.group(2):
+            yield ("sub", pos, 1)
+            pos += 1
+        elif m.group(4):
+            yield ("ins", pos, len(m.group(4)))
+        else:
+            yield ("del", pos, len(m.group(5)))
+            pos += len(m.group(5))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=150)
+    ap.add_argument("--template-len", type=int, default=1300)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--out", default=os.path.join(REPO, "DEPTH2_PROBE.json"))
+    ap.add_argument("--batch", type=int, default=16)
+    args = ap.parse_args(argv)
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(args.seed)
+    err = (0.01, 0.004, 0.004)
+    model = train.DEFAULT_ERROR_MODEL
+    width = train._auto_width(args.template_len)
+
+    main_params = polisher.load_params(polisher.serving_weights_path())
+    low_params = polisher.load_low_depth_params()
+    polish = polisher.make_pipeline_polisher(
+        main_params, min_polish_depth=4,
+        low_depth_params=low_params, low_depth=2,
+    )
+
+    agg = {
+        "n_clusters": 0, "pass_vote": 0, "pass_polish": 0,
+        "errors_vote": [], "errors_polish": [],
+        "by_class": {"sub": 0, "del": 0, "ins": 0},
+        "by_hp": {"hp": 0, "non_hp": 0},
+        "by_evidence": {"agreed_wrong": 0, "disagreed": 0, "uncovered": 0},
+    }
+
+    done = 0
+    while done < args.n:
+        cb = min(args.batch, args.n - done)
+        truths = []
+        codes = np.full((cb, 2, width), encode.PAD_CODE, np.uint8)
+        lens = np.zeros((cb, 2), np.int32)
+        quals = np.zeros((cb, 2, width), np.uint8)
+        strands = np.zeros((cb, 2), bool)
+        for c in range(cb):
+            template = simulator._rand_seq(rng, args.template_len)
+            template_rc = simulator.revcomp(template)
+            truths.append(encode.encode_seq(template))
+            for i in range(2):
+                r, q, is_rev = train._simulate_oriented_read(
+                    rng, template, template_rc, err, model
+                )
+                codes[c, i, : len(r)] = r
+                quals[c, i, : len(q)] = q
+                lens[c, i] = len(r)
+                strands[c, i] = is_rev
+        drafts, dlens = consensus.consensus_clusters_batch(
+            codes, lens, rounds=4, band_width=consensus.POLISH_BAND_WIDTH
+        )
+        drafts, dlens = np.asarray(drafts), np.asarray(dlens)
+        pol, plens = polish(codes, lens, drafts, dlens,
+                            quals=quals, strands=strands)
+
+        # evidence: per-subread base at each POLISHED-draft column
+        from ont_tcrconsensus_tpu.ops import pileup as pileup_mod
+        ba, _, _, _, _ = pileup_mod.pileup_columns_batch(
+            jnp.asarray(codes), jnp.asarray(lens), jnp.asarray(pol),
+            jnp.asarray(plens), band_width=consensus.POLISH_BAND_WIDTH,
+            out_len=pol.shape[1],
+        )
+        ba = np.asarray(ba)  # (C, 2, W) base code per subread per column
+
+        for c in range(cb):
+            truth = truths[c]
+            v = drafts[c, : dlens[c]]
+            p = pol[c, : plens[c]]
+            cs_v = banded_cs(v, truth)
+            cs_p = banded_cs(p, truth)
+            ev = sum(l for _, _, l in parse_cs(cs_v))
+            ep = sum(l for _, _, l in parse_cs(cs_p))
+            cols_v = max(len(truth), len(v))
+            cols_p = max(len(truth), len(p))
+            agg["errors_vote"].append(int(ev))
+            agg["errors_polish"].append(int(ep))
+            agg["pass_vote"] += (1 - ev / cols_v) > BLAST_BAR
+            agg["pass_polish"] += (1 - ep / cols_p) > BLAST_BAR
+            hp = hp_mask(truth)
+            # map truth pos -> polished-draft col: walk the cs ops
+            # (approximate for classification: use truth pos scaled; exact
+            # mapping derived from the cs walk below)
+            tpos_to_ppos = np.full(len(truth) + 1, -1, np.int64)
+            t = q = 0
+            for mm in re.finditer(
+                r":(\d+)|\*([a-z])([a-z])|\+([a-z]+)|-([a-z]+)", cs_p
+            ):
+                if mm.group(1):
+                    k = int(mm.group(1))
+                    tpos_to_ppos[t: t + k] = np.arange(q, q + k)
+                    t += k
+                    q += k
+                elif mm.group(2):
+                    tpos_to_ppos[t] = q
+                    t += 1
+                    q += 1
+                elif mm.group(4):
+                    q += len(mm.group(4))
+                else:
+                    t += len(mm.group(5))
+            for op, tp, ln in parse_cs(cs_p):
+                agg["by_class"][op] += 1
+                in_hp = bool(hp[min(tp, len(truth) - 1)])
+                agg["by_hp"]["hp" if in_hp else "non_hp"] += 1
+                pp = tpos_to_ppos[min(tp, len(truth))]
+                if pp < 0 or pp >= plens[c]:
+                    agg["by_evidence"]["uncovered"] += 1
+                    continue
+                b0, b1 = ba[c, 0, pp], ba[c, 1, pp]
+                if b0 == b1:
+                    agg["by_evidence"]["agreed_wrong"] += 1
+                else:
+                    agg["by_evidence"]["disagreed"] += 1
+        agg["n_clusters"] += cb
+        done += cb
+        print(f"depth2_probe: {done}/{args.n} "
+              f"pass_polish={agg['pass_polish']}/{done}", file=sys.stderr)
+
+    ev = np.array(agg["errors_vote"])
+    ep = np.array(agg["errors_polish"])
+    budget = int(args.template_len * (1 - BLAST_BAR))
+    result = {
+        "n_clusters": agg["n_clusters"],
+        "template_len": args.template_len,
+        "error_budget_per_cluster": budget,
+        "pass_rate_vote": agg["pass_vote"] / agg["n_clusters"],
+        "pass_rate_polish": agg["pass_polish"] / agg["n_clusters"],
+        "errors_per_cluster_vote": {
+            "mean": float(ev.mean()), "p50": float(np.median(ev)),
+            "p90": float(np.percentile(ev, 90)),
+        },
+        "errors_per_cluster_polish": {
+            "mean": float(ep.mean()), "p50": float(np.median(ep)),
+            "p90": float(np.percentile(ep, 90)),
+        },
+        "by_class": agg["by_class"],
+        "by_hp": agg["by_hp"],
+        "by_evidence": agg["by_evidence"],
+    }
+    with open(args.out, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
