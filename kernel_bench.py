@@ -16,6 +16,8 @@ Kernels and their units:
           unit: Gcell/s.
   rnn     polisher inference (models.polisher.apply_logits), the medaka-RNN
           analog. unit: clusters/s (batch rows per second).
+  rnn_bf16  the same network served in bfloat16 (the exactness-A/B-gated
+          polish fast path) — certifies the MXU-rate win on-chip.
   fused   the production fused assign pass (pipeline.assign.AssignEngine)
           on one encoded read batch. unit: reads/s.
 
@@ -32,6 +34,7 @@ node rate (BASELINE.md).
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import os
 import sys
@@ -39,31 +42,34 @@ import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 
-# --- roofline model (VERDICT r4 #5) ---------------------------------------
+# --- roofline reporting (VERDICT r4 #5; recalibrated r6) -------------------
 # The banded-DP kernels are VPU work (int32 adds/max/selects on (8,128)
-# vector registers; the MXU never sees them), so the utilization story is
-# cells/s x ops/cell vs the VPU's peak ALU rate, not a FLOP/s fraction.
-#
-# v5e VPU peak: 8x128 lanes x 4 ALUs x ~1.67 GHz core clock ~ 6.8e12
-# int32 ops/s (public architecture numbers; an estimate, labeled as such).
-PEAK_VPU_OPS_V5E = 8 * 128 * 4 * 1.67e9
-# ops/cell for the SW forward inner loop, counted from sw_pallas._row_step:
-# substitution select (~4: cmp+2 masks+select), E chain (open/ext adds,
-# max, select, open-bit ~6), D (~3), tmp maxes/selects (~6), validity
-# masks (~3), and the F shift-doubling cascade: log2(band)=7 passes of
-# shift+sub+cmp+2 selects over the row, ~5*7/1 ~ 18 amortized per cell.
-# Total ~40 integer lane-ops per DP cell.
-SW_OPS_PER_CELL = 40
-# the pileup forward additionally builds/stores the packed direction
-# planes (tdir bit assembly + fjump tracking in the cascade): ~50/cell.
-PILEUP_OPS_PER_CELL = 50
+# vector registers; the MXU never sees them). The r5 analytic model —
+# "40 VPU ops/cell vs an 8x128x4-ALU x 1.67 GHz = 6.84e12 ops/s peak" —
+# produced mfu_est = 1.1114 for the SW kernel, i.e. the model is WRONG
+# (VERDICT r5 weak #4): an honest recount of sw_pallas._row_step puts the
+# F shift-doubling cascade alone at ~5 ops x log2(128) = 35 ops/cell
+# (it is NOT amortizable — every pass touches every lane), ~57 total, so
+# the measured 190 Gcell/s implies >= 10.8e12 lane-ops/s — above the
+# public-number ALU estimate. Either the VPU sustains more ops/cycle than
+# the 4-ALU figure or Mosaic fuses cmp+select chains; both are invisible
+# from here. An uncalibratable analytic peak is not a roofline, so the
+# report now states utilization against the best MEASURED on-chip rate
+# (provenance below) and keeps the op count only as descriptive context.
+MEASURED_PEAK_GCELLS = {
+    # best observed on-chip rates at these exact shapes: KERNEL_BENCH.json
+    # captured 2026-08-02 on TPU v5 lite (round 5)
+    "sw": 190.066,
+    "pileup": 65.941,
+}
+PEAK_PROVENANCE = "best on-chip capture 2026-08-02, TPU v5 lite (r5)"
 # MXU peak for the RNN serving matmuls (v5e bf16; fp32 serving runs lower,
 # so this mfu_est is a lower bound on achievable headroom).
 PEAK_MXU_FLOPS_V5E = 197e12
 
 
-def _mfu_cells(gcells: float, ops_per_cell: int) -> float:
-    return round(gcells * 1e9 * ops_per_cell / PEAK_VPU_OPS_V5E, 4)
+def _vs_measured_peak(gcells: float, kernel: str) -> float:
+    return round(gcells / MEASURED_PEAK_GCELLS[kernel], 4)
 
 
 SW_PAIRS = 256
@@ -142,9 +148,11 @@ def bench_sw(iters: int) -> dict:
         "unit": "Gcell/s",
         "xla_scan_gcells_per_sec": round(cells / dt_x / 1e9, 3),
         "speedup_vs_xla_scan": round(dt_x / dt_p, 2),
-        "mfu_est": _mfu_cells(gc, SW_OPS_PER_CELL),
-        "mfu_model": f"{SW_OPS_PER_CELL} VPU ops/cell vs "
-                     f"{PEAK_VPU_OPS_V5E:.2e} ops/s v5e VPU peak",
+        "vs_measured_peak": _vs_measured_peak(gc, "sw"),
+        "peak_model": f"{MEASURED_PEAK_GCELLS['sw']} Gcell/s, "
+                      f"{PEAK_PROVENANCE}; ~57 VPU ops/cell "
+                      "(descriptive — the r5 analytic ALU peak measured "
+                      ">1.0 'MFU' and is retired as uncalibratable)",
         "shapes": {"pairs": SW_PAIRS, "len": SW_LEN, "band": SW_BAND},
         "compile_s": round(comp_p, 1),
         "iter_ms": round(dt_p * 1e3, 2),
@@ -175,9 +183,10 @@ def bench_pileup(iters: int) -> dict:
         "metric": "pileup_pallas_gcells_per_sec",
         "value": round(gc, 3),
         "unit": "Gcell/s",
-        "mfu_est": _mfu_cells(gc, PILEUP_OPS_PER_CELL),
-        "mfu_model": f"{PILEUP_OPS_PER_CELL} VPU ops/cell vs "
-                     f"{PEAK_VPU_OPS_V5E:.2e} ops/s v5e VPU peak",
+        "vs_measured_peak": _vs_measured_peak(gc, "pileup"),
+        "peak_model": f"{MEASURED_PEAK_GCELLS['pileup']} Gcell/s, "
+                      f"{PEAK_PROVENANCE} (pre-lane-packing layout; the "
+                      "packed kernel targets ~2x of it)",
         "shapes": {"lanes": PILEUP_LANES, "len": PILEUP_LEN, "band": PILEUP_BAND},
         "compile_s": round(comp, 1),
         "iter_ms": round(dt * 1e3, 2),
@@ -185,6 +194,18 @@ def bench_pileup(iters: int) -> dict:
 
 
 def bench_rnn(iters: int) -> dict:
+    return _bench_rnn(iters, bf16=False)
+
+
+def bench_rnn_bf16(iters: int) -> dict:
+    """The bf16 polish fast path (exactness-A/B-gated in serving,
+    models/polisher.py): certifies the MXU-rate win on-chip. The A/B gate
+    itself is separate evidence (scripts/bf16_ab.py) — this measures only
+    the speed side."""
+    return _bench_rnn(iters, bf16=True)
+
+
+def _bench_rnn(iters: int, bf16: bool) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -199,7 +220,7 @@ def bench_rnn(iters: int) -> dict:
     feats = jnp.asarray(
         rng.random((RNN_BATCH, RNN_LEN, fdim), np.float32)
     )
-    fn = jax.jit(polisher.apply_logits)
+    fn = jax.jit(functools.partial(polisher.apply_logits, bf16=bf16))
     comp, dt = _timed(fn, params, feats, iters=iters)
     # matmul flops per position = 2 * (sum of all 2-D kernel elements);
     # GRU gate matmuls dominate, so this is the roofline numerator
@@ -210,14 +231,16 @@ def bench_rnn(iters: int) -> dict:
     flops_per_pos = 2 * int(sum(k.size for k in kernels))
     pos_per_sec = RNN_BATCH * RNN_LEN / dt
     return {
-        "metric": "rnn_polish_clusters_per_sec",
+        "metric": ("rnn_polish_bf16_clusters_per_sec" if bf16
+                   else "rnn_polish_clusters_per_sec"),
         "value": round(RNN_BATCH / dt, 1),
         "unit": "clusters/s",
         "positions_per_sec": round(pos_per_sec, 0),
         "model_flops_per_pos": flops_per_pos,
         "mfu_est": round(pos_per_sec * flops_per_pos / PEAK_MXU_FLOPS_V5E, 5),
         "mfu_model": f"2*params matmul flops/pos vs {PEAK_MXU_FLOPS_V5E:.0e} "
-                     "bf16 v5e MXU peak (fp32 serving: lower-bound est)",
+                     "bf16 v5e MXU peak"
+                     + ("" if bf16 else " (fp32 serving: lower-bound est)"),
         "shapes": {"batch": RNN_BATCH, "len": RNN_LEN, "features": fdim},
         "compile_s": round(comp, 1),
         "iter_ms": round(dt * 1e3, 2),
@@ -301,6 +324,7 @@ BENCHES = {
     "sw": bench_sw,
     "pileup": bench_pileup,
     "rnn": bench_rnn,
+    "rnn_bf16": bench_rnn_bf16,
     "fused": bench_fused,
     "fused_fast": bench_fused_fast,
 }

@@ -85,8 +85,32 @@ def init_params(rng_seed: int = 0, length: int = 128,
     return model.init(rng, jnp.zeros((1, length, feature_dim)))["params"]
 
 
-def apply_logits(params, feats: jax.Array) -> jax.Array:
-    """(B, L, F) -> (B, L, 10) logits: [:5] class head, [5:] insertion head."""
+def _cast_bf16(tree):
+    """Float leaves -> bf16 (ints/bools untouched)."""
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+        tree,
+    )
+
+
+def apply_logits(params, feats: jax.Array, bf16: bool = False) -> jax.Array:
+    """(B, L, F) -> (B, L, 10) logits: [:5] class head, [5:] insertion head.
+
+    ``bf16`` runs the whole network (params + activations) in bfloat16 and
+    casts the logits back to fp32 — the MXU serves bf16 matmuls at ~2x the
+    fp32 rate on TPU. Serving uses it ONLY behind the exactness A/B gate
+    (:func:`bf16_serving_certified`): the polisher's decisions are
+    argmax/0.9-confidence thresholds, so bf16 logit noise only matters if
+    it flips a decision, and the gate certifies on-backend that it does
+    not (identical consensus output) before the fast path is allowed.
+    """
+    if bf16:
+        logits = ConsensusPolisher().apply(
+            {"params": _cast_bf16(params)},
+            jnp.asarray(feats).astype(jnp.bfloat16),
+        )
+        return logits.astype(jnp.float32)
     return ConsensusPolisher().apply({"params": params}, feats)
 
 
@@ -139,10 +163,10 @@ def polish_draft(
     return out, int(kept.size)
 
 
-def _logits_to_preds(params, feats, base_at):
+def _logits_to_preds(params, feats, base_at, bf16=False):
     from ont_tcrconsensus_tpu.ops import pileup as pileup_mod
 
-    logits = apply_logits(params, feats)  # (C, W, 10)
+    logits = apply_logits(params, feats, bf16=bf16)  # (C, W, 10)
     cls, ins = logits[..., :NUM_CLASSES], logits[..., NUM_CLASSES:]
     probs = jax.nn.softmax(cls, axis=-1)
     pred = jnp.argmax(cls, axis=-1).astype(jnp.uint8)
@@ -154,18 +178,19 @@ def _logits_to_preds(params, feats, base_at):
     return pred, conf, depth, ins_pred, ins_conf
 
 
-def _polish_from_pileup(params, base_at, ins_cnt, ins_base, drafts):
+def _polish_from_pileup(params, base_at, ins_cnt, ins_base, drafts,
+                        bf16=False):
     """(C,S,W) pileup columns -> (pred, conf, depth, ins_pred, ins_conf)."""
     from ont_tcrconsensus_tpu.ops import consensus as consensus_mod
 
     feats = jax.vmap(consensus_mod.pileup_features)(
         base_at, ins_cnt, ins_base, drafts
     )
-    return _logits_to_preds(params, feats, base_at)
+    return _logits_to_preds(params, feats, base_at, bf16=bf16)
 
 
 def _polish_from_pileup_v4(params, base_at, ins_cnt, ins_base, pos_at,
-                           drafts, quals, is_rev):
+                           drafts, quals, is_rev, bf16=False):
     """v4 twin of :func:`_polish_from_pileup`: strand + quality features.
 
     Extra args: ``pos_at`` (C,S,W) from the traceback, ``quals`` (C,S,W)
@@ -176,11 +201,11 @@ def _polish_from_pileup_v4(params, base_at, ins_cnt, ins_base, pos_at,
     feats = jax.vmap(consensus_mod.pileup_features_v4)(
         base_at, ins_cnt, ins_base, drafts, pos_at, quals, is_rev
     )
-    return _logits_to_preds(params, feats, base_at)
+    return _logits_to_preds(params, feats, base_at, bf16=bf16)
 
 
 def _device_polish_batch(params, sub, lens, drafts, dlens, band_width,
-                         mesh=None, quals=None, is_rev=None):
+                         mesh=None, quals=None, is_rev=None, bf16=False):
     """(C,S,W) cluster tile -> (pred (C,W), confidence (C,W), depth (C,W)).
 
     One pileup + one RNN dispatch for the whole tile — the batched medaka
@@ -197,53 +222,60 @@ def _device_polish_batch(params, sub, lens, drafts, dlens, band_width,
     )
     if quals is not None:
         if mesh is not None:
-            return _sharded_polish_from_pileup_v4(mesh)(
+            return _sharded_polish_from_pileup_v4(mesh, bf16)(
                 params, base_at, ins_cnt, ins_base, pos_at, drafts,
                 quals, is_rev,
             )
         return _polish_from_pileup_v4_jit(
-            params, base_at, ins_cnt, ins_base, pos_at, drafts, quals, is_rev
+            params, base_at, ins_cnt, ins_base, pos_at, drafts, quals,
+            is_rev, bf16=bf16,
         )
     if mesh is not None:
-        return _sharded_polish_from_pileup(mesh)(
+        return _sharded_polish_from_pileup(mesh, bf16)(
             params, base_at, ins_cnt, ins_base, drafts
         )
-    return _polish_from_pileup_jit(params, base_at, ins_cnt, ins_base, drafts)
+    return _polish_from_pileup_jit(
+        params, base_at, ins_cnt, ins_base, drafts, bf16=bf16
+    )
 
 
 _device_polish_batch_jit = jax.jit(
-    _device_polish_batch, static_argnames=("band_width",)
+    _device_polish_batch, static_argnames=("band_width", "bf16")
 )
-_polish_from_pileup_jit = jax.jit(_polish_from_pileup)
-_polish_from_pileup_v4_jit = jax.jit(_polish_from_pileup_v4)
+_polish_from_pileup_jit = jax.jit(
+    _polish_from_pileup, static_argnames=("bf16",)
+)
+_polish_from_pileup_v4_jit = jax.jit(
+    _polish_from_pileup_v4, static_argnames=("bf16",)
+)
 
 
 import functools as _functools  # noqa: E402
 
 
 @_functools.lru_cache(maxsize=None)
-def _sharded_polish_from_pileup(mesh):
+def _sharded_polish_from_pileup(mesh, bf16=False):
     """Cluster-axis-sharded RNN serving (params replicated; no collectives)."""
-    from jax import shard_map
+    from ont_tcrconsensus_tpu.parallel.mesh import shard_map_compat as shard_map
     from jax.sharding import PartitionSpec as P
 
     d = P("data")
     return jax.jit(shard_map(
-        _polish_from_pileup, mesh=mesh,
+        _functools.partial(_polish_from_pileup, bf16=bf16), mesh=mesh,
         in_specs=(P(), d, d, d, d), out_specs=(d,) * 5,
         check_vma=False,
     ))
 
 
 @_functools.lru_cache(maxsize=None)
-def _sharded_polish_from_pileup_v4(mesh):
+def _sharded_polish_from_pileup_v4(mesh, bf16=False):
     """v4 twin of :func:`_sharded_polish_from_pileup`."""
-    from jax import shard_map
+    from ont_tcrconsensus_tpu.parallel.mesh import shard_map_compat as shard_map
     from jax.sharding import PartitionSpec as P
 
     d = P("data")
     return jax.jit(shard_map(
-        _polish_from_pileup_v4, mesh=mesh,
+        _functools.partial(_polish_from_pileup_v4, bf16=bf16), mesh=mesh,
         in_specs=(P(), d, d, d, d, d, d, d), out_specs=(d,) * 5,
         check_vma=False,
     ))
@@ -254,7 +286,8 @@ def make_pipeline_polisher(params, band_width: int | None = None,
                            min_polish_depth: int = 4,
                            iterations: int = 1,
                            low_depth_params=None,
-                           low_depth: int = 2):
+                           low_depth: int = 2,
+                           bf16: bool = False):
     """Adapter for ``stages.polish_clusters_all(polisher=...)``.
 
     Returns f(sub (C,S,W), lens (C,S), drafts (C,W), dlens (C,),
@@ -292,6 +325,11 @@ def make_pipeline_polisher(params, band_width: int | None = None,
     predictions instead of keeping the raw vote; all other clusters are
     untouched. Both models share one pileup; the specialist costs one
     extra RNN dispatch per tile only when such clusters exist.
+
+    ``bf16``: serve every RNN dispatch (main + specialist) in bfloat16.
+    Callers must gate this on :func:`bf16_serving_certified` — the
+    per-backend exactness A/B artifact that shows identical consensus
+    output (run.py does; scripts/bf16_ab.py generates the artifact).
     """
     from ont_tcrconsensus_tpu.ops.consensus import POLISH_BAND_WIDTH, QUAL_FILL
     from ont_tcrconsensus_tpu.ops.encode import PAD_CODE
@@ -318,13 +356,22 @@ def make_pipeline_polisher(params, band_width: int | None = None,
     def _serve_from_pileup(p, v4, base_at, ins_cnt, ins_base, pos_at,
                            drafts_d, quals, strands, mesh):
         if v4:
-            fn = (_polish_from_pileup_v4_jit if mesh is None
-                  else _sharded_polish_from_pileup_v4(mesh))
-            return fn(p, base_at, ins_cnt, ins_base, pos_at, drafts_d,
-                      jnp.asarray(quals), jnp.asarray(strands))
-        fn = (_polish_from_pileup_jit if mesh is None
-              else _sharded_polish_from_pileup(mesh))
-        return fn(p, base_at, ins_cnt, ins_base, drafts_d)
+            if mesh is None:
+                return _polish_from_pileup_v4_jit(
+                    p, base_at, ins_cnt, ins_base, pos_at, drafts_d,
+                    jnp.asarray(quals), jnp.asarray(strands), bf16=bf16,
+                )
+            return _sharded_polish_from_pileup_v4(mesh, bf16)(
+                p, base_at, ins_cnt, ins_base, pos_at, drafts_d,
+                jnp.asarray(quals), jnp.asarray(strands),
+            )
+        if mesh is None:
+            return _polish_from_pileup_jit(
+                p, base_at, ins_cnt, ins_base, drafts_d, bf16=bf16
+            )
+        return _sharded_polish_from_pileup(mesh, bf16)(
+            p, base_at, ins_cnt, ins_base, drafts_d
+        )
 
     def _polish_once(sub, lens, drafts, dlens, pileup=None, band_width=None,
                      mesh=None, quals=None, strands=None):
@@ -385,6 +432,7 @@ def make_pipeline_polisher(params, band_width: int | None = None,
                 mesh=mesh,
                 quals=jnp.asarray(quals) if wants_v4 else None,
                 is_rev=jnp.asarray(strands) if wants_v4 else None,
+                bf16=bf16,
             )
         else:
             out = _device_polish_batch_jit(
@@ -393,6 +441,7 @@ def make_pipeline_polisher(params, band_width: int | None = None,
                 default_band if band_width is None else band_width,
                 quals=jnp.asarray(quals) if wants_v4 else None,
                 is_rev=jnp.asarray(strands) if wants_v4 else None,
+                bf16=bf16,
             )
         pred, conf, depth, ins_pred, ins_conf = jax.device_get(out)
         if use_low:
@@ -564,3 +613,169 @@ def load_low_depth_params() -> dict | None:
     if os.path.exists(LOW_DEPTH_WEIGHTS) and os.path.exists(LOW_DEPTH_EVIDENCE):
         return load_params(LOW_DEPTH_WEIGHTS)
     return None
+
+
+# ---------------------------------------------------------------------------
+# bf16 serving gate (the same evidence-artifact discipline as the weights
+# generations): the fast path is allowed only when an on-backend exactness
+# A/B shows byte-identical consensus output.
+
+
+def bf16_ab_artifact_path(backend: str) -> str:
+    return os.path.join(_WEIGHTS_DIR, f"polisher_bf16_ab_{backend}.json")
+
+
+def _current_low_depth_basename() -> str | None:
+    """Basename of the low-depth specialist that would serve right now, or
+    None — the A/B writer and the gate must agree on this so a specialist
+    appearing (or changing) after certification invalidates the cert."""
+    if os.path.exists(LOW_DEPTH_WEIGHTS) and os.path.exists(LOW_DEPTH_EVIDENCE):
+        return os.path.basename(LOW_DEPTH_WEIGHTS)
+    return None
+
+
+def bf16_serving_certified(backend: str | None = None,
+                           device_kind: str | None = None,
+                           min_polish_depth: int = 4) -> bool:
+    """True when bf16 RNN serving is allowed in the current environment
+    (default: the live jax backend + device kind).
+
+    Requires the backend's A/B artifact (:func:`run_bf16_exactness_ab`) to
+    exist, certify ``identical: true``, and to have been produced against
+    (a) the currently-served weights generation, (b) the currently-active
+    low-depth specialist (including its absence — the specialist's RNN
+    dispatch is part of the A/B only when it was live at capture time),
+    (c) the same accelerator generation (``device_kind``) — bf16 rounding
+    through a different MXU/compiler generation can flip a 0.9-confidence
+    decision a v5e cert never exercised — and (d) the same serving gate
+    config (``min_polish_depth``): a lowered depth gate serves the main
+    RNN in depth regimes the A/B routed elsewhere. A retrain, a
+    specialist change, a hardware change, or a gate-config change all
+    force a re-certify. CPU is always False: XLA emulates bf16 there
+    slower than fp32, so the fast path has nothing to win even when
+    exact.
+    """
+    import json
+
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+        if device_kind is None:
+            device_kind = jax.devices()[0].device_kind
+    if backend == "cpu":
+        return False
+    path = bf16_ab_artifact_path(backend)
+    if not os.path.exists(path):
+        return False
+    try:
+        with open(path) as fh:
+            rec = json.load(fh)
+    except (OSError, ValueError):
+        return False
+    return (
+        bool(rec.get("identical"))
+        and rec.get("weights") == os.path.basename(serving_weights_path())
+        and rec.get("low_depth_weights") == _current_low_depth_basename()
+        and rec.get("min_polish_depth") == min_polish_depth
+        and (device_kind is None or rec.get("device_kind") == device_kind)
+    )
+
+
+def run_bf16_exactness_ab(
+    n_clusters: int = 96,
+    depths: tuple[int, ...] = (2, 4, 6, 10),
+    template_len: int = 1300,
+    seed: int = 17,
+    out_path: str | None = None,
+    write: bool = True,
+    min_polish_depth: int = 4,
+) -> dict:
+    """Exactness A/B: fp32 vs bf16 pipeline polisher on simulated clusters.
+
+    Builds ``n_clusters`` clusters cycling over ``depths`` with the
+    systematic ONT error model (the bench/eval regime), runs the FULL
+    pipeline polisher (vote consensus -> RNN polish, low-depth specialist
+    included when bundled) once in fp32 and once in bf16, and compares the
+    polished (codes, lengths) byte-exactly.  Writes the per-backend gate
+    artifact consumed by :func:`bf16_serving_certified` and returns it.
+
+    The comparison is decision-level by construction: both runs share the
+    identical vote consensus and pileup, so any divergence is exactly a
+    bf16-flipped polisher decision — which is what the gate must exclude.
+    """
+    import json
+    import time
+
+    import jax
+
+    from ont_tcrconsensus_tpu.io import simulator
+    from ont_tcrconsensus_tpu.models import train
+    from ont_tcrconsensus_tpu.ops import consensus, encode
+
+    rng = np.random.default_rng(seed)
+    err = (0.01, 0.004, 0.004)
+    model = train.DEFAULT_ERROR_MODEL
+    width = train._auto_width(template_len)
+    s_max = max(depths)
+
+    main_params = load_params(serving_weights_path())
+    low_params = load_low_depth_params()
+
+    def make_polisher(bf16):
+        return make_pipeline_polisher(
+            main_params, min_polish_depth=min_polish_depth,
+            low_depth_params=low_params, low_depth=2, bf16=bf16,
+        )
+
+    codes = np.full((n_clusters, s_max, width), encode.PAD_CODE, np.uint8)
+    lens = np.zeros((n_clusters, s_max), np.int32)
+    quals = np.zeros((n_clusters, s_max, width), np.uint8)
+    strands = np.zeros((n_clusters, s_max), bool)
+    for c in range(n_clusters):
+        depth = depths[c % len(depths)]
+        template = simulator._rand_seq(rng, template_len)
+        template_rc = simulator.revcomp(template)
+        for i in range(depth):
+            r, q, is_rev = train._simulate_oriented_read(
+                rng, template, template_rc, err, model
+            )
+            codes[c, i, : len(r)] = r
+            quals[c, i, : len(q)] = q
+            lens[c, i] = len(r)
+            strands[c, i] = is_rev
+    drafts, dlens = consensus.consensus_clusters_batch(
+        codes, lens, rounds=4, band_width=consensus.POLISH_BAND_WIDTH
+    )
+    drafts, dlens = np.asarray(drafts), np.asarray(dlens)
+
+    out32, len32 = make_polisher(False)(
+        codes, lens, drafts.copy(), dlens.copy(), quals=quals, strands=strands
+    )
+    out16, len16 = make_polisher(True)(
+        codes, lens, drafts.copy(), dlens.copy(), quals=quals, strands=strands
+    )
+    mismatch = int(np.sum(
+        (np.asarray(len32) != np.asarray(len16))
+        | (np.asarray(out32) != np.asarray(out16)).any(axis=1)
+    ))
+    backend = jax.default_backend()
+    rec = {
+        "backend": backend,
+        "device_kind": jax.devices()[0].device_kind,
+        "weights": os.path.basename(serving_weights_path()),
+        "low_depth_weights": _current_low_depth_basename(),
+        "min_polish_depth": min_polish_depth,
+        "identical": mismatch == 0,
+        "n_clusters": n_clusters,
+        "mismatched_clusters": mismatch,
+        "depths": list(depths),
+        "template_len": template_len,
+        "seed": seed,
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    if write:
+        path = out_path or bf16_ab_artifact_path(backend)
+        with open(path, "w") as fh:
+            json.dump(rec, fh, indent=1)
+    return rec

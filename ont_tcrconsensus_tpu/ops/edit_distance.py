@@ -165,7 +165,7 @@ def many_vs_many_dovetail(queries, q_lens, targets, t_lens, k_end: int = 8):
 @functools.lru_cache(maxsize=None)
 def _sharded_pairwise_dovetail(mesh, k_end: int):
     """Pair-axis-sharded :func:`pairwise_dovetail` (zero collectives)."""
-    from jax import shard_map
+    from ont_tcrconsensus_tpu.parallel.mesh import shard_map_compat as shard_map
     from jax.sharding import PartitionSpec as P
 
     fn = jax.vmap(lambda x, xl, y, yl: _dovetail_pair(x, xl, y, yl, k_end))
@@ -179,7 +179,7 @@ def _sharded_pairwise_dovetail(mesh, k_end: int):
 @functools.lru_cache(maxsize=None)
 def _sharded_mvm_dovetail(mesh, k_end: int):
     """Query-axis-sharded :func:`many_vs_many_dovetail` (targets replicated)."""
-    from jax import shard_map
+    from ont_tcrconsensus_tpu.parallel.mesh import shard_map_compat as shard_map
     from jax.sharding import PartitionSpec as P
 
     def fn(queries, q_lens, targets, t_lens):

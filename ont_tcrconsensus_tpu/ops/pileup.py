@@ -435,7 +435,7 @@ def _sharded_pileup_fn(mesh, band_width: int, out_len: int):
     pass (pipeline/assign.py) and the TPU mapping of the reference's
     node-wide medaka fan-out (ref medaka_polish.py:95-144; VERDICT r2 #3).
     """
-    from jax import shard_map
+    from ont_tcrconsensus_tpu.parallel.mesh import shard_map_compat as shard_map
     from jax.sharding import PartitionSpec as P
 
     def base(reads, rlens, refs, reflens):

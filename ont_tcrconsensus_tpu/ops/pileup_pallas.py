@@ -5,16 +5,25 @@ the interpreter and the ``-m tpu`` lane), but the row recurrence runs with
 its DP carry resident in VMEM instead of round-tripping HBM every scan step
 — the same trade :mod:`.sw_pallas` makes for the stats-only kernel. The
 direction planes (``tdir``/``fjump``) are emitted row-by-row into one
-lane-packed (BLK, L, 2*W) uint8 output block, and the existing XLA
-``lax.while_loop`` traceback (:func:`.pileup._traceback_one`) consumes them
-unchanged.
+lane-packed uint8 output block, and the existing XLA ``lax.while_loop``
+traceback (:func:`.pileup._traceback_one`) consumes them unchanged.
 
 Layout tricks (see sw_pallas for the pattern):
 - drafts are pre-shifted host-side into ``ref_shifted[lane, k] =
   draft[k - W/2]`` so each row's band window is one contiguous slice;
-- both planes share one output ref with the band (W=64) doubled along the
-  minor axis to a full 128-lane tile: ``[:, i, :W] = tdir``,
-  ``[:, i, W:] = fjump``;
+- **full-lane packing**: the VPU's native tile is (8, 128) lanes, so a
+  64-lane band leaves half of every vector register idle. The production
+  polish band (W=64) therefore packs TWO reads side by side per sublane
+  row — read A on lanes [0, 64), read B on [64, 128) — and every band
+  shift masks the half boundary so the two bands never leak into each
+  other. Per-instruction lane occupancy doubles at the SAME VMEM
+  footprint per read (the planes block stays 32 KiB/read-row), which is
+  the whole gap the pre-packing kernel left: its (16, 64) arrays occupied
+  2 half-empty tiles per op. W=128 degenerates to one read per row
+  (pack=1), the old layout exactly;
+- both planes share one output ref: per packed row the minor axis holds
+  ``[tdir_A | tdir_B | fjump_A | fjump_B]`` (W lanes each), unpacked
+  host-side into the (N, L, W) planes the traceback expects;
 - the per-slot best (score, earliest row) is tracked in VMEM and the
   sequential tie-break (max score -> earliest row -> smallest slot) is
   reproduced outside the kernel.
@@ -45,25 +54,34 @@ from ont_tcrconsensus_tpu.ops.sw_align import (
 )
 
 _NEG = -(1 << 24)
-BLK = 16  # lanes (subread alignments) per program
+BLK = 16   # reads (subread alignments) per program
+LANES = 128  # VPU lane tile; pack = LANES // W reads share one sublane row
 
 
-def _forward_kernel(read_ref, refsh_ref, rlen_ref, tlen_ref,
-                    planes_ref, bestH_ref, bestRow_ref,
-                    *, L, W, match, mismatch, gap_open, gap_ext):
+def _forward_kernel(*refs, L, W, p, match, mismatch, gap_open, gap_ext):
+    """``refs``: p read refs, p refsh refs, p rlen refs, p tlen refs, then
+    planes/bestH/bestRow outputs. ``p`` reads are packed along the lane
+    axis (read k of a row owns lanes [k*W, (k+1)*W))."""
+    reads_r = refs[:p]
+    refsh_r = refs[p : 2 * p]
+    rlen_r = refs[2 * p : 3 * p]
+    tlen_r = refs[3 * p : 4 * p]
+    planes_ref, bestH_ref, bestRow_ref = refs[4 * p : 4 * p + 3]
+
+    rows = BLK // p
     c = W // 2
-    iota = jax.lax.broadcasted_iota(jnp.int32, (BLK, W), 1)
-    rlen = rlen_ref[:]
-    tlen = tlen_ref[:]
-    neg = jnp.full((BLK, W), _NEG, jnp.int32)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1)
+    band_pos = lane % W                     # slot within each read's band
+    half = lane // W                        # which packed read owns the lane
+    lane128 = lane  # elem_at's 128-chunk selector (LANES == 128)
+    neg = jnp.full((rows, LANES), _NEG, jnp.int32)
 
-    lane128 = jax.lax.broadcasted_iota(jnp.int32, (BLK, 128), 1)
-
-    def shift_up(x, fill):
-        return jnp.concatenate([x[:, 1:], jnp.full((BLK, 1), fill, x.dtype)], axis=1)
-
-    def shift_right(x, step, fill):
-        return jnp.concatenate([jnp.full((BLK, step), fill, x.dtype), x[:, :-step]], axis=1)
+    def by_half(vals):
+        """(rows, 1) per-read scalars -> (rows, LANES) lane-selected."""
+        out = jnp.broadcast_to(vals[0], (rows, LANES))
+        for k in range(1, p):
+            out = jnp.where(half == k, jnp.broadcast_to(vals[k], (rows, LANES)), out)
+        return out
 
     def elem_at(ref, k):
         base = pl.multiple_of((k // 128) * 128, 128)
@@ -71,15 +89,48 @@ def _forward_kernel(read_ref, refsh_ref, rlen_ref, tlen_ref,
         sel = lane128 == (k % 128)
         return jnp.sum(jnp.where(sel, chunk, 0), axis=1, keepdims=True)
 
+    def shift_up(x, fill):
+        """Band-slot b <- b+1 within each packed read; fill at each band's
+        top slot (the half boundary must not leak read B into read A)."""
+        rolled = jnp.concatenate(
+            [x[:, 1:], jnp.full((rows, 1), fill, x.dtype)], axis=1
+        )
+        return jnp.where(band_pos == W - 1, fill, rolled) if p > 1 else rolled
+
+    def shift_right(x, step, fill):
+        """Band-slot b <- b-step within each packed read; fill the first
+        ``step`` slots of every band."""
+        rolled = jnp.concatenate(
+            [jnp.full((rows, step), fill, x.dtype), x[:, :-step]], axis=1
+        )
+        return jnp.where(band_pos < step, fill, rolled) if p > 1 else rolled
+
+    rlen = by_half([r[:] for r in rlen_r])
+    tlen = by_half([r[:] for r in tlen_r])
+
     def row_step(i, carry):
         H, E, bH, bRow, window = carry
-        jrow = i - c + iota                         # (BLK, W); offsets are 0
+        jrow = i - c + band_pos                     # offsets are 0
         valid = (jrow >= 0) & (jrow < tlen) & (i < rlen)
-        rbase = elem_at(read_ref, i)
+        rbase = by_half([elem_at(r, i) for r in reads_r])
         tbase = window
         is_match = (tbase == rbase) & (rbase < 4) & (tbase < 4)
         sub = jnp.where(is_match, match, -mismatch)
-        window = jnp.concatenate([window[:, 1:], elem_at(refsh_ref, i + W)], axis=1)
+        # advance each packed band's window by one: slot w-1 of read k
+        # takes refsh_k[i + W]
+        nexts = [elem_at(r, i + W) for r in refsh_r]
+        rolled = jnp.concatenate(
+            [window[:, 1:],
+             jnp.broadcast_to(nexts[-1], (rows, 1)).astype(window.dtype)],
+            axis=1,
+        )
+        window = rolled
+        for k in range(p - 1):
+            window = jnp.where(
+                (band_pos == W - 1) & (half == k),
+                jnp.broadcast_to(nexts[k], (rows, LANES)).astype(window.dtype),
+                window,
+            )
 
         H_up = shift_up(H, _NEG)
         E_up = shift_up(E, _NEG)
@@ -105,7 +156,9 @@ def _forward_kernel(read_ref, refsh_ref, rlen_ref, tlen_ref,
         tmp = jnp.where(valid, tmp, neg)
         tdir = tdir | jnp.where(e_open, _EOPEN_BIT, 0)
 
-        # F cascade (shift-doubling) with ref-gap run length tracking
+        # F cascade (shift-doubling) with ref-gap run length tracking;
+        # shifts are per-band, so the cascade never crosses the half
+        # boundary and runs log2(W) passes exactly as unpacked
         g = tmp
         gap = jnp.zeros_like(tmp)
         step = 1
@@ -126,7 +179,9 @@ def _forward_kernel(read_ref, refsh_ref, rlen_ref, tlen_ref,
         imp = H_new > bH
         bH = jnp.where(imp, H_new, bH)
         bRow = jnp.where(
-            imp, jnp.broadcast_to(jnp.full((BLK, 1), i, jnp.int32), (BLK, W)), bRow
+            imp,
+            jnp.broadcast_to(jnp.full((rows, 1), i, jnp.int32), (rows, LANES)),
+            bRow,
         )
         E_new = jnp.where(valid, E_new, neg)
         return (H_new, E_new, bH, bRow, window), tdir, fjump
@@ -137,18 +192,21 @@ def _forward_kernel(read_ref, refsh_ref, rlen_ref, tlen_ref,
 
     def group_body(gi, carry):
         i0 = gi * G
-        rows = []
+        rows_out = []
         for k in range(G):
             carry, tdir, fjump = row_step(i0 + k, carry)
-            rows.append(jnp.concatenate([tdir, fjump], axis=1))
-        block = jnp.stack(rows, axis=1)  # (BLK, G, 2W) int32
+            rows_out.append(jnp.concatenate([tdir, fjump], axis=1))
+        block = jnp.stack(rows_out, axis=1)  # (rows, G, 2*LANES) int32
         planes_ref[:, pl.ds(pl.multiple_of(i0, G), G), :] = block.astype(jnp.uint8)
         return carry
 
-    window0 = refsh_ref[:, 0:W].astype(jnp.int32)
+    window0 = jnp.concatenate(
+        [r[:, 0:W].astype(jnp.int32) for r in refsh_r], axis=1
+    )
     init = (
         neg, neg,
-        jnp.zeros((BLK, W), jnp.int32), jnp.full((BLK, W), -1, jnp.int32),
+        jnp.zeros((rows, LANES), jnp.int32),
+        jnp.full((rows, LANES), -1, jnp.int32),
         window0,
     )
     out = jax.lax.fori_loop(0, L // G, group_body, init)
@@ -184,8 +242,8 @@ def forward_planes_pallas(
     if L % 128:
         raise ValueError(
             f"read width {L} must be a multiple of 128: elem_at() loads "
-            "128-aligned lane chunks from the (BLK, L) read block, so any "
-            "ragged tail sends the last chunk load out of the block "
+            "128-aligned lane chunks from the read block, so any ragged "
+            "tail sends the last chunk load out of the block "
             "(pad_batch pads to multiples of 128 upstream)"
         )
     if band_width not in (64, 128):
@@ -195,6 +253,8 @@ def forward_planes_pallas(
         )
     W = band_width
     c = W // 2
+    p = LANES // W              # reads packed per sublane row (2 at W=64)
+    rows = BLK // p
     N = ((N0 + BLK - 1) // BLK) * BLK
 
     def pad_to(x, n, fill):
@@ -225,35 +285,67 @@ def forward_planes_pallas(
     )
 
     kernel = functools.partial(
-        _forward_kernel, L=L, W=W, match=MATCH, mismatch=MISMATCH,
+        _forward_kernel, L=L, W=W, p=p, match=MATCH, mismatch=MISMATCH,
         gap_open=GAP_OPEN, gap_ext=GAP_EXT,
     )
     grid = (N // BLK,)
-    row_spec = lambda cols: pl.BlockSpec(
-        (BLK, cols), lambda g: (g, 0), memory_space=pltpu.VMEM
-    )
+    # packed read k of program g occupies row-block p*g + k of the (N, ...)
+    # inputs: rows [16g, 16g+8) are half A, [16g+8, 16g+16) half B
+    def row_spec(cols, k):
+        return pl.BlockSpec(
+            (rows, cols), lambda g, k=k: (p * g + k, 0),
+            memory_space=pltpu.VMEM,
+        )
+
     planes_spec = pl.BlockSpec(
-        (BLK, L, 2 * W), lambda g: (g, 0, 0), memory_space=pltpu.VMEM
+        (rows, L, 2 * LANES), lambda g: (g, 0, 0), memory_space=pltpu.VMEM
+    )
+    best_spec = pl.BlockSpec(
+        (rows, LANES), lambda g: (g, 0), memory_space=pltpu.VMEM
+    )
+    in_specs = (
+        [row_spec(L, k) for k in range(p)]
+        + [row_spec(K, k) for k in range(p)]
+        + [row_spec(1, k) for k in range(p)]
+        + [row_spec(1, k) for k in range(p)]
     )
     planes, bestH, bestRow = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[row_spec(L), row_spec(K), row_spec(1), row_spec(1)],
-        out_specs=[planes_spec, row_spec(W), row_spec(W)],
+        in_specs=in_specs,
+        out_specs=[planes_spec, best_spec, best_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((N, L, 2 * W), jnp.uint8),
-            jax.ShapeDtypeStruct((N, W), jnp.int32),
-            jax.ShapeDtypeStruct((N, W), jnp.int32),
+            jax.ShapeDtypeStruct((N // p, L, 2 * LANES), jnp.uint8),
+            jax.ShapeDtypeStruct((N // p, LANES), jnp.int32),
+            jax.ShapeDtypeStruct((N // p, LANES), jnp.int32),
         ],
         interpret=interpret,
-    )(reads_p, ref_shifted, rlens, tlens)
+    )(*([reads_p] * p + [ref_shifted] * p + [rlens] * p + [tlens] * p))
+
+    # unpack the lane-packed halves back to per-read (N, L, W) planes and
+    # (N, W) best rows: read r = BLK*g + W_half*rows' ... i.e. row-major
+    # (program, half, row) ordering by construction of row_spec
+    G_n = N // BLK
+    if p > 1:
+        planes = planes.reshape(G_n, rows, L, 2, p, W)
+        # [..., 0, k, :] = tdir of half k; [..., 1, k, :] = fjump of half k
+        planes = jnp.moveaxis(planes, 4, 1)          # (G, p, rows, L, 2, W)
+        planes = planes.reshape(N, L, 2, W)
+        tdir = planes[:, :, 0, :]
+        fjump = planes[:, :, 1, :]
+        bh = jnp.moveaxis(bestH.reshape(G_n, rows, p, W), 2, 1).reshape(N, W)
+        br = jnp.moveaxis(bestRow.reshape(G_n, rows, p, W), 2, 1).reshape(N, W)
+    else:
+        tdir = planes[:, :, :W]
+        fjump = planes[:, :, W:]
+        bh, br = bestH, bestRow
 
     # sequential tie-break: max score -> earliest row -> smallest slot
-    score = jnp.max(bestH, axis=1)
-    is_max = bestH == score[:, None]
-    row_or_inf = jnp.where(is_max, bestRow, jnp.int32(1 << 30))
+    score = jnp.max(bh, axis=1)
+    is_max = bh == score[:, None]
+    row_or_inf = jnp.where(is_max, br, jnp.int32(1 << 30))
     best_row = jnp.min(row_or_inf, axis=1)
-    cand = is_max & (bestRow == best_row[:, None])
+    cand = is_max & (br == best_row[:, None])
     slot = jnp.argmax(cand, axis=1).astype(jnp.int32)
     # _forward_banded reports best0 = (0, -1, 0) when nothing scored > 0
     aligned = score > 0
@@ -265,6 +357,4 @@ def forward_planes_pallas(
         ],
         axis=1,
     )
-    tdir = planes[:, :, :W]
-    fjump = planes[:, :, W:]
     return best[:N0], tdir[:N0], fjump[:N0]

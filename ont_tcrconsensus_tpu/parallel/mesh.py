@@ -49,6 +49,26 @@ def make_mesh(shape: dict[str, int] | None = None, devices=None) -> Mesh:
     return Mesh(arr, names)
 
 
+def shard_map_compat(f, mesh: Mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` across jax generations.
+
+    Newer jax exports ``shard_map`` at top level with a ``check_vma`` knob;
+    older releases (e.g. the 0.4.x line some containers pin) only have
+    ``jax.experimental.shard_map`` where the same knob is ``check_rep``.
+    Every shard_map call site routes through here so the multichip paths
+    run (and are tested) on both.
+    """
+    try:
+        from jax import shard_map
+    except ImportError:  # jax < 0.5: experimental home, check_rep spelling
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_vma)
+    return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_vma=check_vma)
+
+
 def mesh_data_size(mesh: Mesh) -> int:
     """Size of the mesh's ``data`` axis (the one shared helper for every
     divisibility check before a shard_map dispatch)."""

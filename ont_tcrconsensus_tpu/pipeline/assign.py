@@ -683,7 +683,7 @@ class AssignEngine:
         key = (has_quals, fast)
         if key in self._sharded_cache:
             return self._sharded_cache[key]
-        from jax import shard_map
+        from ont_tcrconsensus_tpu.parallel.mesh import shard_map_compat as shard_map
         from jax.sharding import PartitionSpec as P
 
         kwstat = self._static_kwargs(has_quals, fast)
@@ -747,7 +747,7 @@ class AssignEngine:
         key = ("targeted", max_c)
         if key in self._sharded_cache:
             return self._sharded_cache[key]
-        from jax import shard_map
+        from ont_tcrconsensus_tpu.parallel.mesh import shard_map_compat as shard_map
         from jax.sharding import PartitionSpec as P
 
         kwstat = dict(band_width=self.band_width, a5=self.a5, a3=self.a3,

@@ -154,6 +154,16 @@ class RunConfig:
     error_profile_sample: int = 512  # reads/library profiled for the cs-tag
     #   error artifact (qc/error_profile.py); 0 disables. 512 resolves any
     #   motif above ~1% of reads in the top-40 dump; raise for deeper audits
+    overlap_qc: bool = True  # run the error-profile passes on worker
+    #   threads overlapped with round-1 polish / round-2 clustering
+    #   (pipeline/overlap.py); artifacts stay byte-identical — False
+    #   restores the fully serial stage order
+    polish_bf16: bool = True  # allow bf16 polisher serving WHEN the
+    #   per-backend exactness A/B artifact certifies identical consensus
+    #   output (models/polisher.py bf16_serving_certified; generate with
+    #   scripts/bf16_ab.py). Without a certifying artifact — or on the CPU
+    #   backend, where XLA emulates bf16 slower than fp32 — serving stays
+    #   fp32 regardless of this flag; False forces fp32 everywhere
 
     @property
     def cluster_identity(self) -> float:

@@ -1,0 +1,134 @@
+"""Off-critical-path stage execution with ordered artifact commits.
+
+The round-5 on-chip bench spends 15.3 s of its 46.8 s wall (33%) computing
+the error-profile QC artifact — a log nothing downstream consumes —
+serially between stages (BENCH_r05.json).  This module runs such
+side-artifact stages on bounded worker threads, overlapped with the
+critical-path device stages (round-1 polish, round-2 clustering), while
+keeping every artifact byte-identical to the serial run:
+
+- COMPUTE happens on a worker thread.  The QC pass reads only immutable
+  columnar blocks and dispatches its own jitted tiles; jax dispatch is
+  thread-safe, so its device work simply interleaves into the stream
+  between the critical path's dispatches (total device work is unchanged —
+  the win is hiding each side's host gaps behind the other's compute).
+- COMMIT (file writes + failure propagation) happens on the MAIN thread,
+  in submission order, at a fixed point before the library's manifest is
+  marked complete — so artifact content and completion semantics are
+  exactly the serial run's (a crash before commit leaves the library
+  incomplete and resume retries it, as before).
+- In-flight work is bounded by a permit semaphore — the same bounded
+  in-flight discipline as the fused-pass drive (assign.py:1053-1117) — so
+  background stages cannot pile up unbounded sample buffers behind a fast
+  producer.
+
+StageTimer accounting is split the same way: the stage's own timer entry
+records only the CRITICAL-PATH cost (the blocking wait at the commit
+point; ~0 when the overlap worked), and the worker's wall clock is
+recorded under ``<stage>_bg`` so the breakdown stays honest about where
+the compute went (bench.py excludes ``_bg`` entries from the
+critical-path sum).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class DeferredStage:
+    """One background stage: compute on a worker, result at commit time."""
+
+    def __init__(self, name: str, permits: threading.Semaphore):
+        self.name = name
+        self._permits = permits
+        self._done = threading.Event()
+        self._result = None
+        self._exc: BaseException | None = None
+        self.worker_seconds = 0.0
+
+    def _run(self, fn, args, kwargs) -> None:
+        t0 = time.perf_counter()
+        try:
+            self._result = fn(*args, **kwargs)
+        except BaseException as exc:  # re-raised on the main thread at commit
+            self._exc = exc
+        finally:
+            self.worker_seconds = time.perf_counter() - t0
+            self._done.set()
+            self._permits.release()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self):
+        """Block until the worker finishes; re-raise its failure here."""
+        self._done.wait()
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+class StageExecutor:
+    """Bounded-worker scheduler for stages whose artifacts nothing on the
+    critical path consumes.
+
+    ``max_in_flight`` bounds concurrently-live background stages (permit
+    acquired at submit, released when the worker finishes): each deferred
+    stage pins its input buffers (e.g. a whole library's read store) until
+    committed, so the bound is a memory bound, not just a thread bound.
+    """
+
+    def __init__(self, max_in_flight: int = 2):
+        self._permits = threading.Semaphore(max_in_flight)
+        self._pending: list[DeferredStage] = []
+
+    def submit(self, name: str, fn, /, *args, **kwargs) -> DeferredStage:
+        """Start ``fn(*args, **kwargs)`` on a worker thread; blocks only
+        when ``max_in_flight`` stages are already live."""
+        self._permits.acquire()
+        stage = DeferredStage(name, self._permits)
+        threading.Thread(
+            target=stage._run, args=(fn, args, kwargs),
+            name=f"stage-{name}", daemon=True,
+        ).start()
+        self._pending.append(stage)
+        return stage
+
+    def commit(self, stage: DeferredStage, timer=None):
+        """Block until ``stage`` finishes and return its result, re-raising
+        any worker failure on this (the main) thread.
+
+        With ``timer``, the blocking wait is recorded under the stage's own
+        name (the critical-path cost) and the worker's full wall clock
+        under ``<name>_bg`` (the overlapped cost).
+        """
+        try:
+            if timer is not None:
+                with timer.stage(stage.name):
+                    result = stage.wait()
+                timer.add(stage.name + "_bg", stage.worker_seconds)
+            else:
+                result = stage.wait()
+        finally:
+            # a failed commit must still retire the stage, or wait_all()
+            # on the failure path would re-report the same exception as a
+            # second 'also failed' background stage
+            if stage in self._pending:
+                self._pending.remove(stage)
+        return result
+
+    def wait_all(self) -> list[tuple[str, BaseException]]:
+        """Wait for every pending stage WITHOUT raising; returns the
+        failures as (name, exception) pairs.  The failure-path cleanup hook:
+        a library that died on the critical path must not leave workers
+        racing ahead into the next library's run."""
+        failures: list[tuple[str, BaseException]] = []
+        for stage in list(self._pending):
+            try:
+                stage.wait()
+            except BaseException as exc:
+                failures.append((stage.name, exc))
+            self._pending.remove(stage)
+        return failures

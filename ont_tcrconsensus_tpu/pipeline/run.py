@@ -34,7 +34,7 @@ import numpy as np
 
 from ont_tcrconsensus_tpu.cluster import regions as regions_mod
 from ont_tcrconsensus_tpu.io import bucketing, fastx, layout
-from ont_tcrconsensus_tpu.pipeline import stages
+from ont_tcrconsensus_tpu.pipeline import overlap, stages
 from ont_tcrconsensus_tpu.pipeline.config import RunConfig
 from ont_tcrconsensus_tpu.qc import artifacts, umi_overlap
 from ont_tcrconsensus_tpu.qc.timing import StageTimer
@@ -160,9 +160,17 @@ def _run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]
                 if cfg.low_depth_polish and cfg.min_reads_per_cluster <= 2
                 else None
             )
+            # bf16 serving only behind the per-backend exactness A/B gate
+            # (identical consensus output certified on THIS backend class;
+            # scripts/bf16_ab.py regenerates the artifact)
+            use_bf16 = cfg.polish_bf16 and polisher_mod.bf16_serving_certified(
+                min_polish_depth=cfg.min_polish_depth
+            )
+            if use_bf16:
+                _log("polisher: bf16 serving enabled (exactness A/B certified)")
             polisher = polisher_mod.make_pipeline_polisher(
                 params, min_polish_depth=cfg.min_polish_depth,
-                low_depth_params=low_params,
+                low_depth_params=low_params, bf16=use_bf16,
             )
         else:
             _log("polish_method=rnn but no bundled weights; using vote consensus only")
@@ -309,6 +317,49 @@ def _run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]
 def _run_library(fastq, lay, cfg, panel, engine, engine_notrim,
                  blast_id_threshold, overlap_consensus, polisher,
                  read_batch, budget) -> dict[str, int]:
+    # Overlapped QC executor: error-profile passes run on worker threads
+    # concurrently with round-1 polish / round-2 clustering, committing
+    # their (byte-identical) log artifacts at fixed points before each
+    # round's resume checkpoint (pipeline/overlap.py, _commit_pending_qc).
+    qc_exec = overlap.StageExecutor() if cfg.overlap_qc else None
+    try:
+        return _run_library_impl(
+            fastq, lay, cfg, panel, engine, engine_notrim,
+            blast_id_threshold, overlap_consensus, polisher,
+            read_batch, budget, qc_exec,
+        )
+    except BaseException:
+        # a critical-path failure must not leave overlapped QC workers
+        # uncommitted (their failures would vanish and their buffers would
+        # outlive the library) — drain them, then let the failure propagate
+        if qc_exec is not None:
+            for name, exc in qc_exec.wait_all():
+                _log(f"WARNING: overlapped stage {name} also failed: {exc!r}")
+        raise
+
+
+def _commit_pending_qc(qc_exec, pending_qc, timer) -> None:
+    """Commit overlapped QC stages (write logs, surface failures) in
+    submission order on the main thread; clears the list.  Every commit
+    point sits BEFORE the stage checkpoint that would let resume skip the
+    producing round — a crash between compute and commit therefore leaves
+    the round unmarked and resume regenerates the artifact, exactly like
+    the serial run."""
+    if not pending_qc:
+        return
+    from ont_tcrconsensus_tpu.qc import error_profile
+
+    for stage, log_path in pending_qc:
+        counters = qc_exec.commit(stage, timer)
+        error_profile.write_error_profile_log(*counters, log_path)
+        _log(f"qc: {stage.name} computed off the critical path "
+             f"({stage.worker_seconds:.1f}s overlapped)")
+    pending_qc.clear()
+
+
+def _run_library_impl(fastq, lay, cfg, panel, engine, engine_notrim,
+                      blast_id_threshold, overlap_consensus, polisher,
+                      read_batch, budget, qc_exec) -> dict[str, int]:
     library = lay.library
     merged_path = os.path.join(lay.fasta, "merged_consensus.fasta")
     timer = StageTimer()
@@ -321,7 +372,8 @@ def _run_library(fastq, lay, cfg, panel, engine, engine_notrim,
         ]
         return _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
                            overlap_consensus, merged_consensus, timer,
-                           read_batch, budget, round1_complete=True)
+                           read_batch, budget, round1_complete=True,
+                           qc_exec=qc_exec)
 
     # PHASE B + round-1 assignment: ONE fused device pass per batch
     # (trim -> EE -> align -> UMI locate; preprocessing.py:7-159 +
@@ -352,17 +404,28 @@ def _run_library(fastq, lay, cfg, panel, engine, engine_notrim,
         astats, os.path.join(lay.logs, f"{library}_flagstat.log")
     )
 
+    pending_qc: list[tuple[overlap.DeferredStage, str]] = []
     if cfg.error_profile_sample:
         from ont_tcrconsensus_tpu.qc import error_profile
 
-        with timer.stage("round1_error_profile"):
-            counters = error_profile.profile_store(
-                store, panel, sample_size=cfg.error_profile_sample
-            )
-            error_profile.write_error_profile_log(
-                *counters,
-                os.path.join(lay.logs, f"{library}_align_error_profile.log"),
-            )
+        r1_log = os.path.join(lay.logs, f"{library}_align_error_profile.log")
+        if qc_exec is not None:
+            # off the critical path: computed while polish runs, committed
+            # (log written, failures surfaced) before the round-1
+            # checkpoint below
+            pending_qc.append((
+                qc_exec.submit(
+                    "round1_error_profile", error_profile.profile_store,
+                    store, panel, sample_size=cfg.error_profile_sample,
+                ),
+                r1_log,
+            ))
+        else:
+            with timer.stage("round1_error_profile"):
+                counters = error_profile.profile_store(
+                    store, panel, sample_size=cfg.error_profile_sample
+                )
+                error_profile.write_error_profile_log(*counters, r1_log)
 
     groups = stages.group_by_region_cluster(store, panel)
     if cfg.write_intermediate_fastas:
@@ -482,6 +545,13 @@ def _run_library(fastq, lay, cfg, panel, engine, engine_notrim,
             for group_name, err in failed_groups:
                 fh.write(f"{group_name}\t{err}\n")
 
+    # round-1 QC must commit BEFORE the round1_consensus checkpoint below:
+    # once that stage is marked, resume skips round 1 entirely, so a crash
+    # later in round 2 would otherwise lose the round-1 log forever. The
+    # overlap still spans the whole polish stage (the round's dominant
+    # block); only round-2-spanning overlap is given up for the round-1
+    # pass.
+    _commit_pending_qc(qc_exec, pending_qc, timer)
     fastx.write_fasta(merged_path, merged_consensus)
     if not failed_groups:
         # incomplete round 1 is NOT checkpointed: resume must retry the
@@ -490,7 +560,8 @@ def _run_library(fastq, lay, cfg, panel, engine, engine_notrim,
     return _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
                        overlap_consensus, merged_consensus, timer,
                        read_batch, budget,
-                       round1_complete=not failed_groups)
+                       round1_complete=not failed_groups,
+                       qc_exec=qc_exec, pending_qc=pending_qc)
 
 
 _R2_HEADER = re.compile(r"^region_cluster(\d+)_cluster\d+_\d+$")
@@ -551,7 +622,9 @@ def _targeted_round2_dispatch(panel, engine, headers):
 
 def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
                 overlap_consensus, merged_consensus, timer,
-                read_batch, budget, round1_complete: bool = True) -> dict[str, int]:
+                read_batch, budget, round1_complete: bool = True,
+                qc_exec=None, pending_qc=()) -> dict[str, int]:
+    pending_qc = list(pending_qc)
     library = lay.library
 
     # round 2: consensus align + blast-id filter + split by exact region
@@ -594,14 +667,23 @@ def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
     if cfg.error_profile_sample:
         from ont_tcrconsensus_tpu.qc import error_profile
 
-        with timer.stage("round2_error_profile"):
-            counters = error_profile.profile_store(
-                cons_store, panel, sample_size=cfg.error_profile_sample
-            )
-            error_profile.write_error_profile_log(
-                *counters,
-                os.path.join(lay.logs, "merged_consensus_align_error_profile.log"),
-            )
+        r2_log = os.path.join(lay.logs, "merged_consensus_align_error_profile.log")
+        if qc_exec is not None:
+            # overlapped with round-2 clustering below; committed with the
+            # round-1 pass at the end of this function
+            pending_qc.append((
+                qc_exec.submit(
+                    "round2_error_profile", error_profile.profile_store,
+                    cons_store, panel, sample_size=cfg.error_profile_sample,
+                ),
+                r2_log,
+            ))
+        else:
+            with timer.stage("round2_error_profile"):
+                counters = error_profile.profile_store(
+                    cons_store, panel, sample_size=cfg.error_profile_sample
+                )
+                error_profile.write_error_profile_log(*counters, r2_log)
     region_groups = stages.group_by_region(cons_store, panel)
     if cfg.write_intermediate_fastas:
         stages.write_region_fastas(region_groups, cons_store, lay.region_fasta, "region_")
@@ -684,6 +766,14 @@ def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
         umi_overlap.count_overlapping_umis(
             region_cluster_umis, lay.logs, cfg.overlapping_umi_edit_threshold
         )
+    # COMMIT point for overlapped round-2 QC: fixed position (always
+    # before the stage-timing artifact and the counts manifest mark),
+    # submission order, main thread — log bytes and failure/resume
+    # semantics are exactly the serial run's, only the wall position
+    # moved. (Round-1 QC committed before its own checkpoint in
+    # _run_library_impl.)
+    if qc_exec is not None:
+        _commit_pending_qc(qc_exec, pending_qc, timer)
     timer.write_tsv(os.path.join(lay.logs, "stage_timing.tsv"))
     if round1_complete and not failed_regions:
         # incomplete counts are not checkpointed: resume must retry the

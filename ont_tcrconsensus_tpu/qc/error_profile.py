@@ -281,7 +281,9 @@ def banded_cs_batch(queries: list[np.ndarray], refs: list[np.ndarray],
 # traceback both run as lax.scan on the accelerator; only a compact per-step
 # op log (kind + the two base codes) returns to host, where the cs string is
 # assembled per contiguous segment instead of per base.  Output is
-# bit-identical to banded_cs_batch (asserted by tests/test_qc.py).
+# bit-identical to banded_cs_batch (asserted by
+# tests/test_qc.py::test_error_profile_device_matches_batch over
+# ragged/degenerate/band-outlier cases).
 
 _K_MATCH, _K_SUB, _K_INS, _K_DEL, _K_STOP = 0, 1, 2, 3, 4
 

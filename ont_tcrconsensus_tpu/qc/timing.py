@@ -28,6 +28,12 @@ class StageTimer:
             self.seconds[name] += time.perf_counter() - t0
             self.calls[name] += 1
 
+    def add(self, name: str, seconds: float) -> None:
+        """Record externally-measured seconds (e.g. an overlapped worker's
+        wall clock, pipeline/overlap.py) under ``name``."""
+        self.seconds[name] += seconds
+        self.calls[name] += 1
+
     def merge(self, other: "StageTimer") -> None:
         for k, v in other.seconds.items():
             self.seconds[k] += v

@@ -1,0 +1,70 @@
+"""bf16 polisher serving exactness A/B (the gate artifact generator).
+
+The polish fast path serves the bi-GRU in bfloat16 — ~2x MXU rate on TPU —
+but ONLY behind an on-backend exactness gate: serving flips to bf16 when
+(and only when) this A/B shows byte-identical consensus output on the
+backend class the pipeline will run on. This script runs the A/B (fp32 vs
+bf16 full pipeline polisher — shared vote consensus and pileup, so any
+divergence is exactly a bf16-flipped polisher decision — over simulated
+ONT-error clusters at depths 2/4/6/10) and writes the per-backend artifact
+``models/weights/polisher_bf16_ab_<backend>.json`` that
+``polisher.bf16_serving_certified`` consults.
+
+Run it on the backend you will serve on (a retrain or weights-generation
+change invalidates the artifact — the gate checks the weights basename):
+
+    python scripts/bf16_ab.py                  # current backend
+    python scripts/bf16_ab.py --force-cpu      # machinery check on host
+    python scripts/bf16_ab.py --n 256          # deeper certification
+
+Exit code 0 when identical (artifact certifies bf16), 1 when not (artifact
+records the mismatch and serving stays fp32 — the gate's default).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=96, help="clusters to A/B")
+    ap.add_argument("--template-len", type=int, default=1300)
+    ap.add_argument("--seed", type=int, default=17)
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default: per-backend gate path)")
+    ap.add_argument("--force-cpu", action="store_true")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if args.force_cpu:
+        # the axon plugin overrides JAX_PLATFORMS; the config API is the
+        # only reliable CPU override (tests/conftest.py has the story)
+        jax.config.update("jax_platforms", "cpu")
+
+    from ont_tcrconsensus_tpu.models import polisher
+
+    rec = polisher.run_bf16_exactness_ab(
+        n_clusters=args.n, template_len=args.template_len, seed=args.seed,
+        out_path=args.out,
+    )
+    print(json.dumps(rec, indent=1))
+    if rec["identical"]:
+        print(f"bf16_ab: IDENTICAL on {rec['backend']} — bf16 serving "
+              "certified", file=sys.stderr)
+        return 0
+    print(f"bf16_ab: {rec['mismatched_clusters']}/{rec['n_clusters']} "
+          f"clusters diverged on {rec['backend']} — serving stays fp32",
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -180,12 +180,23 @@ def main(argv=None) -> int:
                 elif mm.group(4):
                     q += len(mm.group(4))
                 else:
-                    t += len(mm.group(5))
+                    k = len(mm.group(5))
+                    # deletion-consumed truth positions map to the FLANKING
+                    # polished column (the q position the deletion applies
+                    # before) so their pileup evidence is inspectable;
+                    # leaving them -1 misbucketed every deletion error as
+                    # 'uncovered' (DEPTH2_PROBE.json: uncovered==del==559),
+                    # silently excluding ~22% of errors from the
+                    # agreed/disagreed split VERDICT decisions rest on
+                    tpos_to_ppos[t : t + k] = q
+                    t += k
             for op, tp, ln in parse_cs(cs_p):
                 agg["by_class"][op] += 1
                 in_hp = bool(hp[min(tp, len(truth) - 1)])
                 agg["by_hp"]["hp" if in_hp else "non_hp"] += 1
                 pp = tpos_to_ppos[min(tp, len(truth))]
+                if op == "del" and pp >= plens[c]:
+                    pp = plens[c] - 1  # deletion at the draft end flanks left
                 if pp < 0 or pp >= plens[c]:
                     agg["by_evidence"]["uncovered"] += 1
                     continue

@@ -256,3 +256,135 @@ def test_pileup_pallas_full_width_draft():
     )
     for a, b, name in zip(ref, got, ("base_at", "ins_cnt", "ins_base", "pos_at", "spans")):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
+
+
+def _sim_clusters(rng, C, S_range, W, template_len, rates=(0.03, 0.012, 0.012)):
+    sub = np.full((C, max(S_range), W), encode.PAD_CODE, np.uint8)
+    lens = np.zeros((C, max(S_range)), np.int32)
+    drafts_true = []
+    for c in range(C):
+        template = simulator._rand_seq(rng, template_len)
+        drafts_true.append(template)
+        for i in range(int(rng.integers(S_range[0], S_range[1] + 1))):
+            s, _ = simulator.mutate(rng, template, *rates)
+            e = encode.encode_seq(s)[:W]
+            sub[c, i, : len(e)] = e
+            lens[c, i] = len(e)
+    return sub, lens
+
+
+def test_fused_pair_rounds_match_unfused():
+    """The 2-rounds-per-dispatch fused pair program (vote -> extend ->
+    vote -> extend in-program, ops/consensus._fused_pair_fn) must be
+    bit-identical to the unfused per-round host loop — drafts, lengths AND
+    the reused final pileup — across converge-early, converge-late, empty
+    and end-erosion clusters."""
+    rng = np.random.default_rng(23)
+    C, W = 8, 256
+    sub, lens = _sim_clusters(rng, C, (2, 6), W, 190)
+    sub[3] = encode.PAD_CODE  # empty cluster: the no-alignment path
+    lens[3] = 0
+    for keep_pos in (True, False):
+        ref = consensus.consensus_clusters_batch(
+            sub, lens, rounds=4, band_width=64,
+            keep_final_pileup=True, keep_pos=keep_pos,
+        )
+        got = consensus.consensus_clusters_batch(
+            sub, lens, rounds=4, band_width=64,
+            keep_final_pileup=True, keep_pos=keep_pos, force_fused=True,
+        )
+        np.testing.assert_array_equal(np.asarray(ref[0]), np.asarray(got[0]))
+        np.testing.assert_array_equal(np.asarray(ref[1]), np.asarray(got[1]))
+        assert (ref[2] is None) == (got[2] is None)
+        if ref[2] is not None:
+            names = ("base_at", "ins_cnt", "ins_base", "pos_at")
+            for a, b, name in zip(ref[2], got[2], names):
+                if a is None or b is None:
+                    assert a is None and b is None, name
+                    continue
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b), err_msg=name
+                )
+
+
+def test_fused_pair_odd_rounds_and_no_pileup():
+    """Odd rounds caps exercise the trailing single-round program behind
+    the pairs; keep_final_pileup=False exercises the plain return."""
+    rng = np.random.default_rng(29)
+    C, W = 4, 256
+    sub, lens = _sim_clusters(rng, C, (3, 5), W, 180)
+    for rounds in (1, 3):
+        ref = consensus.consensus_clusters_batch(
+            sub, lens, rounds=rounds, band_width=64
+        )
+        got = consensus.consensus_clusters_batch(
+            sub, lens, rounds=rounds, band_width=64, force_fused=True
+        )
+        np.testing.assert_array_equal(np.asarray(ref[0]), np.asarray(got[0]))
+        np.testing.assert_array_equal(np.asarray(ref[1]), np.asarray(got[1]))
+
+
+def test_extend_ends_device_matches_batch():
+    """The in-program end-extension (jnp) must mirror the host numpy
+    version on synthetic span geometries, including the
+    majority-at-boundary and width-cap gates."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(31)
+    C, S, W = 6, 4, 64
+    sub = rng.integers(0, 4, (C, S, W)).astype(np.uint8)
+    slens = rng.integers(W // 2, W, (C, S)).astype(np.int32)
+    drafts = rng.integers(0, 4, (C, W)).astype(np.uint8)
+    dlens = rng.integers(W // 2, W - 1, (C,)).astype(np.int32)
+    dlens[5] = W  # at the width cap: extension must be suppressed
+    spans = np.zeros((C, S, 4), np.int32)
+    spans[:, :, 0] = rng.integers(0, 3, (C, S))        # r_start
+    spans[:, :, 1] = slens - rng.integers(0, 3, (C, S))  # r_end
+    spans[:, :, 2] = rng.integers(0, 2, (C, S))        # f_start
+    spans[:, :, 3] = dlens[:, None] - rng.integers(0, 2, (C, S))  # f_end
+    aligned = dlens.copy()
+    ref_d, ref_l = consensus._extend_ends_batch(
+        drafts.copy(), dlens.copy(), sub, slens, spans, aligned
+    )
+    got_d, got_l = consensus._extend_ends_device(
+        jnp.asarray(drafts), jnp.asarray(dlens), jnp.asarray(sub),
+        jnp.asarray(slens), jnp.asarray(spans), jnp.asarray(aligned),
+    )
+    np.testing.assert_array_equal(ref_d, np.asarray(got_d))
+    np.testing.assert_array_equal(ref_l, np.asarray(got_l))
+
+
+def test_pileup_pallas_packed_layout_bands():
+    """Direct plane-level parity of the lane-packed Pallas forward against
+    the XLA forward, for BOTH supported bands (64 packs two reads per
+    128-lane tile, 128 one) and a ragged lane count spanning multiple
+    programs plus padding."""
+    from ont_tcrconsensus_tpu.ops import pileup, pileup_pallas
+
+    rng = np.random.default_rng(41)
+    N, L = 18, 256  # > one 16-read program; pads to 32
+    refs = rng.integers(0, 4, size=(N, L)).astype(np.uint8)
+    reads = refs.copy()
+    mut = rng.random(reads.shape) < 0.08
+    reads = np.where(mut, (reads + 1) % 4, reads).astype(np.uint8)
+    rlens = rng.integers(L // 2, L + 1, size=N).astype(np.int32)
+    tlens = rng.integers(L // 2, L + 1, size=N).astype(np.int32)
+    rlens[5] = 0  # dead lane
+    for band in (64, 128):
+        best_p, tdir_p, fjump_p = pileup_pallas.forward_planes_pallas(
+            reads, rlens, refs, tlens, band_width=band, interpret=True
+        )
+        best_x, planes_x = pileup._forward_batch(
+            reads, rlens, refs, tlens, band_width=band
+        )
+        tdir_x = (np.asarray(planes_x) & 15).astype(np.uint8)
+        fjump_x = (np.asarray(planes_x) >> 4).astype(np.uint8)
+        np.testing.assert_array_equal(
+            np.asarray(tdir_p), tdir_x, err_msg=f"tdir band={band}"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(fjump_p), fjump_x, err_msg=f"fjump band={band}"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(best_p), np.asarray(best_x), err_msg=f"best band={band}"
+        )

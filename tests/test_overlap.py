@@ -1,0 +1,82 @@
+"""Overlapped stage executor (pipeline/overlap.py)."""
+
+import threading
+import time
+
+import pytest
+
+from ont_tcrconsensus_tpu.pipeline.overlap import StageExecutor
+from ont_tcrconsensus_tpu.qc.timing import StageTimer
+
+
+def test_commit_returns_result_and_records_split_timing():
+    ex = StageExecutor()
+    timer = StageTimer()
+    gate = threading.Event()
+
+    def work():
+        gate.wait(5.0)
+        time.sleep(0.05)
+        return {"answer": 42}
+
+    stage = ex.submit("qc_stage", work)
+    gate.set()
+    result = ex.commit(stage, timer)
+    assert result == {"answer": 42}
+    # critical-path entry = blocking wait; _bg entry = worker wall clock
+    assert "qc_stage" in timer.seconds
+    assert timer.seconds["qc_stage_bg"] >= 0.05
+    assert not ex.wait_all()  # committed stages are no longer pending
+
+
+def test_commit_reraises_worker_failure_on_main_thread():
+    ex = StageExecutor()
+
+    def boom():
+        raise ValueError("qc exploded")
+
+    stage = ex.submit("bad_stage", boom)
+    with pytest.raises(ValueError, match="qc exploded"):
+        ex.commit(stage)
+
+
+def test_wait_all_collects_failures_without_raising():
+    ex = StageExecutor()
+    ex.submit("ok", lambda: 1)
+    ex.submit("bad", lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    failures = ex.wait_all()
+    assert [name for name, _ in failures] == ["bad"]
+    assert isinstance(failures[0][1], RuntimeError)
+    assert not ex.wait_all()
+
+
+def test_permits_bound_in_flight_stages():
+    """The permit semaphore caps live background stages: a third submit
+    blocks until one of the first two finishes (the memory bound —
+    deferred stages pin their input buffers)."""
+    ex = StageExecutor(max_in_flight=2)
+    release = threading.Event()
+    started = []
+
+    def work(i):
+        started.append(i)
+        release.wait(5.0)
+        return i
+
+    s1 = ex.submit("a", work, 1)
+    s2 = ex.submit("b", work, 2)
+    t0 = time.perf_counter()
+    blocker: list = []
+
+    def third():
+        blocker.append(ex.submit("c", work, 3))
+
+    t = threading.Thread(target=third)
+    t.start()
+    time.sleep(0.15)
+    assert not blocker  # still blocked on the permit
+    release.set()
+    t.join(5.0)
+    assert blocker and time.perf_counter() - t0 >= 0.1
+    assert ex.commit(s1) == 1 and ex.commit(s2) == 2
+    assert ex.commit(blocker[0]) == 3

@@ -374,3 +374,60 @@ def test_low_depth_specialist_pass_scope():
     )
     assert changed, "depth-2 specialist never fired"
     np.testing.assert_array_equal(o_e[1], o_p[1])  # depth-3 still vote
+
+
+def test_bf16_logits_shape_and_dtype():
+    """The bf16 serving path produces fp32 logits of the same shape as the
+    fp32 path (values certified separately by the exactness A/B)."""
+    import jax.numpy as jnp
+
+    params = polisher.init_params(length=32)
+    feats = np.random.default_rng(0).random((2, 32, polisher.FEATURE_DIM))
+    lo32 = polisher.apply_logits(params, jnp.asarray(feats, jnp.float32))
+    lo16 = polisher.apply_logits(
+        params, jnp.asarray(feats, jnp.float32), bf16=True
+    )
+    assert lo16.shape == lo32.shape
+    assert lo16.dtype == jnp.float32
+    # bf16 is an approximation of the fp32 logits, not garbage
+    assert float(jnp.max(jnp.abs(lo16 - lo32))) < 0.5
+
+
+def test_bf16_serving_gate(tmp_path, monkeypatch):
+    """bf16_serving_certified: artifact-gated, per-backend, weights- and
+    specialist-pinned, device-kind-pinned, and never on for CPU."""
+    import json
+    import os
+
+    monkeypatch.setattr(polisher, "_WEIGHTS_DIR", str(tmp_path))
+    served = os.path.basename(polisher.serving_weights_path())
+    low = polisher._current_low_depth_basename()
+
+    # no artifact -> off
+    assert not polisher.bf16_serving_certified("tpu")
+    # certifying artifact -> on for that backend (+ matching device kind)
+    rec = {"backend": "tpu", "identical": True, "weights": served,
+           "low_depth_weights": low, "device_kind": "TPU v5 lite",
+           "min_polish_depth": 4}
+    with open(tmp_path / "polisher_bf16_ab_tpu.json", "w") as fh:
+        json.dump(rec, fh)
+    assert polisher.bf16_serving_certified("tpu", "TPU v5 lite")
+    assert not polisher.bf16_serving_certified("axon", "TPU v5 lite")
+    # a DIFFERENT accelerator generation was never A/B'd -> off
+    assert not polisher.bf16_serving_certified("tpu", "TPU v6e")
+    # a different serving gate config (min_polish_depth) was never A/B'd
+    assert not polisher.bf16_serving_certified(
+        "tpu", "TPU v5 lite", min_polish_depth=2
+    )
+    # cpu is categorically off (bf16 emulation is slower there)
+    with open(tmp_path / "polisher_bf16_ab_cpu.json", "w") as fh:
+        json.dump({**rec, "backend": "cpu", "device_kind": "cpu"}, fh)
+    assert not polisher.bf16_serving_certified("cpu", "cpu")
+    # a failed A/B, a weights-generation change, or a low-depth specialist
+    # change all invalidate the cert
+    for bad in ({"identical": False},
+                {"weights": "stale_generation.msgpack"},
+                {"low_depth_weights": "other_specialist.msgpack"}):
+        with open(tmp_path / "polisher_bf16_ab_tpu.json", "w") as fh:
+            json.dump({**rec, **bad}, fh)
+        assert not polisher.bf16_serving_certified("tpu", "TPU v5 lite"), bad

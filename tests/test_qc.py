@@ -248,6 +248,54 @@ def test_error_profile_batch_matches_single():
     assert batch == single
 
 
+def test_error_profile_device_matches_batch():
+    """banded_cs_batch_device (the accelerator cs path profile_store routes
+    every non-CPU backend through) is bit-identical to banded_cs_batch over
+    ragged lengths, degenerate empty inputs, and band-width outliers that
+    must fall back to the single-read path — the regression guard the
+    module comment at qc/error_profile.py promises (mirrors
+    test_error_profile_batch_matches_single)."""
+    import numpy as np
+
+    from ont_tcrconsensus_tpu.qc.error_profile import (
+        banded_cs_batch,
+        banded_cs_batch_device,
+    )
+
+    rng = np.random.default_rng(7)
+    queries, refs = [], []
+    for _ in range(40):
+        m = int(rng.integers(1, 400))
+        r = rng.integers(0, 4, size=m).astype(np.uint8)
+        q = list(r)
+        i = 0
+        out = []
+        while i < len(q):
+            roll = rng.random()
+            if roll < 0.02:
+                out.append(int(rng.integers(0, 4)))  # sub
+            elif roll < 0.04:
+                pass  # deletion
+            elif roll < 0.06:
+                out.extend([q[i], int(rng.integers(0, 4))])  # insertion
+            else:
+                out.append(q[i])
+            i += 1
+        queries.append(np.array(out, np.uint8))
+        refs.append(r)
+    # degenerate rows: empty query / empty ref
+    queries += [np.zeros(0, np.uint8), np.array([1, 2], np.uint8)]
+    refs += [np.array([1, 2, 3], np.uint8), np.zeros(0, np.uint8)]
+    # band outliers (|n - m| far above the band): the device path must
+    # route them through the scalar fallback, like the host batch does
+    queries += [np.array([2], np.uint8), rng.integers(0, 4, 300).astype(np.uint8)]
+    refs += [rng.integers(0, 4, 260).astype(np.uint8), np.array([3], np.uint8)]
+    # tile=16 forces multiple fixed-shape device tiles over the live rows
+    device = banded_cs_batch_device(queries, refs, tile=16)
+    host = banded_cs_batch(queries, refs)
+    assert device == host
+
+
 def test_stats_artifacts(tmp_path):
     from ont_tcrconsensus_tpu.pipeline.assign import AlignStats, LengthStats
     from ont_tcrconsensus_tpu.qc import artifacts

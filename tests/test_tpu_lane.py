@@ -35,7 +35,15 @@ def _device_env() -> dict:
     Only an explicit CPU pin is stripped so discovery can run.
     """
     env = dict(os.environ)
-    if env.get("JAX_PLATFORMS", "").strip().lower().startswith("cpu"):
+    platforms = [
+        tok.strip() for tok in env.get("JAX_PLATFORMS", "").split(",")
+        if tok.strip() and tok.strip().lower() != "cpu"
+    ]
+    if platforms:
+        # composite pin like "cpu,axon": drop only the cpu token so the
+        # experimental plugin request survives into the child
+        env["JAX_PLATFORMS"] = ",".join(platforms)
+    elif "JAX_PLATFORMS" in env:
         del env["JAX_PLATFORMS"]
     # The conftest's virtual-CPU-mesh flag breaks the tunnel plugin's
     # backend registration in a child process; it is CPU-suite-only.
@@ -213,6 +221,9 @@ eng = assign.AssignEngine(panel, cfg.umi_fwd, cfg.umi_rev, primers=[])
 recs = [fastx.FastxRecord(h.split()[0], "", s, None) for h, s, _ in lib.reads]
 batch = next(bucketing.batch_reads(recs, batch_size=64, with_quals=False))
 full = eng.run_batch(batch, max_ee_rate=1.0, min_len=1)
+# every row must be valid before compressing is_rev with the mask: a
+# filtered read would silently zip-truncate and misalign the flags
+assert batch.valid.all(), batch.valid
 comp = str.maketrans("ACGT", "TGCA")
 oriented = [
     fastx.FastxRecord(
