@@ -12,7 +12,10 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import sys
 import time
+
+from ont_tcrconsensus_tpu.robustness import faults
 
 SUBDIRS = (
     "logs",
@@ -80,17 +83,52 @@ class LibraryLayout:
     # --- stage-level resume -------------------------------------------------
 
     def completed_stages(self) -> dict[str, float]:
-        if not os.path.exists(self.manifest_path):
+        """Stage -> completion time from the manifest.
+
+        Corruption-tolerant: a torn/invalid manifest (the process was
+        killed mid-write by a preemption, or the disk lied) means "no
+        stages done" with a warning — resume then redoes the library's
+        work, which is always safe, instead of crashing the whole run on
+        a ``JSONDecodeError`` and bricking ``resume=true``.
+        """
+        try:
+            with open(self.manifest_path) as fh:
+                raw = fh.read()
+        except FileNotFoundError:
             return {}
-        with open(self.manifest_path) as fh:
-            return json.load(fh)
+        except OSError as exc:
+            print(f"WARNING: cannot read stage manifest {self.manifest_path} "
+                  f"({exc!r}); treating as no stages done", file=sys.stderr)
+            return {}
+        try:
+            done = json.loads(raw)
+        except ValueError:
+            print(f"WARNING: stage manifest {self.manifest_path} is "
+                  "torn/corrupt; treating as no stages done (resume will "
+                  "redo this library)", file=sys.stderr)
+            return {}
+        if not isinstance(done, dict):
+            print(f"WARNING: stage manifest {self.manifest_path} has "
+                  f"unexpected shape {type(done).__name__}; treating as no "
+                  "stages done", file=sys.stderr)
+            return {}
+        return done
 
     def mark_stage_done(self, stage: str) -> None:
         done = self.completed_stages()
         done[stage] = time.time()
+        payload = json.dumps(done, indent=1)
+        if faults.tear_write("layout.manifest_write", self.manifest_path, payload):
+            return  # chaos: the "crash mid-write" already happened
         tmp = self.manifest_path + ".tmp"
         with open(tmp, "w") as fh:
-            json.dump(done, fh, indent=1)
+            fh.write(payload)
+            fh.flush()
+            # fsync BEFORE the rename: os.replace is atomic in the
+            # namespace but not in the page cache — without the sync a
+            # power cut can leave the new name pointing at zero-length
+            # data, exactly the torn state completed_stages() tolerates
+            os.fsync(fh.fileno())
         os.replace(tmp, self.manifest_path)
 
     def stage_done(self, stage: str) -> bool:

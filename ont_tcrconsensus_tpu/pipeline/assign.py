@@ -35,6 +35,7 @@ import numpy as np
 
 from ont_tcrconsensus_tpu.io import bucketing, fastx
 from ont_tcrconsensus_tpu.ops import ee_filter, encode, fuzzy_match, sketch, sw_pallas
+from ont_tcrconsensus_tpu.robustness import faults as robustness_faults
 
 MIN_SCORE = 100  # SW score gate for a "primary alignment" equivalent
 BIG_DIST = 1 << 20  # sentinel distance for "no qualifying primer hit"
@@ -1096,6 +1097,10 @@ def run_assign(
         ):
             if not acquire_permit():
                 break
+            # chaos site: a transient device fault on the fused-pass
+            # dispatch (raises out of run_assign; run.py retries the whole
+            # idempotent pass under the transient policy)
+            robustness_faults.inject("assign.dispatch")
             if dispatch is not None:
                 # gate params flow from THIS call site for both paths, so
                 # the EE/length filter cannot drift between them

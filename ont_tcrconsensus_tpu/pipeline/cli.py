@@ -44,8 +44,16 @@ def main(argv: list[str] | None = None) -> int:
         jax.distributed.initialize()
 
     from ont_tcrconsensus_tpu.pipeline.run import run_pipeline
+    from ont_tcrconsensus_tpu.robustness import shutdown
 
-    run_pipeline(args.json_config_file)
+    try:
+        run_pipeline(args.json_config_file)
+    except shutdown.Preempted as p:
+        # preemption-safe exit: committed checkpoints are intact; 143 is
+        # the conventional SIGTERM status so orchestrators reschedule
+        print(f"preempted: {p}; rerun with resume=true to continue",
+              file=sys.stderr)
+        return 143
     return 0
 
 

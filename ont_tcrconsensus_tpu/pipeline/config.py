@@ -158,6 +158,20 @@ class RunConfig:
     #   threads overlapped with round-1 polish / round-2 clustering
     #   (pipeline/overlap.py); artifacts stay byte-identical — False
     #   restores the fully serial stage order
+    # --- robustness (robustness/; new, no reference analogue) ---
+    retry_max_attempts: int = 3  # total attempts per dispatch site for
+    #   TRANSIENT-classified failures (device/transport faults): 3 = one
+    #   dispatch + two backoff retries. Deterministic bugs never retry;
+    #   HBM OOM instead re-derives a shrunken batch from parallel/budget.py
+    #   and requeues (stages.polish_clusters_all)
+    retry_base_delay_s: float = 0.1  # first backoff delay; doubles per
+    #   attempt (jittered, capped at 5 s — robustness/retry.RetryPolicy)
+    chaos: list | None = None  # fault-injection plan: list of spec dicts
+    #   ({"site": ..., "kind": ..., "skip": ..., "times": ...};
+    #   robustness/faults.py) armed at run start. The TCR_CHAOS env var
+    #   arms the same way when this key is null. None/[] = chaos off
+    #   (injection points are a single global check)
+    chaos_seed: int = 0  # seed for probabilistic ("p") chaos specs
     polish_bf16: bool = True  # allow bf16 polisher serving WHEN the
     #   per-backend exactness A/B artifact certifies identical consensus
     #   output (models/polisher.py bf16_serving_certified; generate with
@@ -249,6 +263,27 @@ class RunConfig:
             raise ValueError("min_umi_length > max_umi_length")
         if self.min_reads_per_cluster > self.max_reads_per_cluster:
             raise ValueError("min_reads_per_cluster > max_reads_per_cluster")
+        if not isinstance(self.retry_max_attempts, int) or self.retry_max_attempts < 1:
+            raise ValueError(
+                f"retry_max_attempts={self.retry_max_attempts!r} must be a "
+                "positive int (1 = no retries)"
+            )
+        if not isinstance(self.retry_base_delay_s, (int, float)) or (
+            self.retry_base_delay_s < 0
+        ):
+            raise ValueError(
+                f"retry_base_delay_s={self.retry_base_delay_s!r} must be a "
+                "non-negative number"
+            )
+        if self.chaos is not None:
+            if not isinstance(self.chaos, list) or not all(
+                isinstance(s, dict) for s in self.chaos
+            ):
+                raise ValueError("chaos must be null or a list of fault-spec dicts")
+            from ont_tcrconsensus_tpu.robustness import faults as faults_mod
+
+            for s in self.chaos:  # validates site/kind; typos fail fast
+                faults_mod.FaultSpec(**s)
         if self.polish_method not in ("poa", "rnn"):
             raise ValueError(f"polish_method={self.polish_method!r} not in ('poa', 'rnn')")
         for pat_name in ("umi_fwd", "umi_rev"):

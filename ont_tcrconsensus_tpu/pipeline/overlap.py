@@ -35,6 +35,8 @@ from __future__ import annotations
 import threading
 import time
 
+from ont_tcrconsensus_tpu.robustness import faults
+
 
 class DeferredStage:
     """One background stage: compute on a worker, result at commit time."""
@@ -45,11 +47,15 @@ class DeferredStage:
         self._done = threading.Event()
         self._result = None
         self._exc: BaseException | None = None
+        self._call: tuple | None = None  # (fn, args, kwargs) for rerun_sync
         self.worker_seconds = 0.0
 
     def _run(self, fn, args, kwargs) -> None:
         t0 = time.perf_counter()
         try:
+            # chaos site: a worker thread dying mid-stage (the injected
+            # exception surfaces at commit, like any real worker failure)
+            faults.inject("overlap.worker")
             self._result = fn(*args, **kwargs)
         except BaseException as exc:  # re-raised on the main thread at commit
             self._exc = exc
@@ -57,6 +63,17 @@ class DeferredStage:
             self.worker_seconds = time.perf_counter() - t0
             self._done.set()
             self._permits.release()
+
+    def rerun_sync(self):
+        """Re-execute the stage's callable on the CALLING thread.
+
+        The retry path for a dead/failed worker: the inputs are immutable
+        columnar blocks, so a synchronous re-run produces the identical
+        artifact — only the overlap is lost. Raises whatever the callable
+        raises; the caller owns classification and retry bounds.
+        """
+        fn, args, kwargs = self._call
+        return fn(*args, **kwargs)
 
     @property
     def done(self) -> bool:
@@ -89,6 +106,7 @@ class StageExecutor:
         when ``max_in_flight`` stages are already live."""
         self._permits.acquire()
         stage = DeferredStage(name, self._permits)
+        stage._call = (fn, args, kwargs)
         threading.Thread(
             target=stage._run, args=(fn, args, kwargs),
             name=f"stage-{name}", daemon=True,
@@ -106,9 +124,14 @@ class StageExecutor:
         """
         try:
             if timer is not None:
-                with timer.stage(stage.name):
-                    result = stage.wait()
-                timer.add(stage.name + "_bg", stage.worker_seconds)
+                try:
+                    with timer.stage(stage.name):
+                        result = stage.wait()
+                finally:
+                    # record the worker's wall clock even when the stage
+                    # FAILED — the timing table must not under-report
+                    # exactly the runs someone is diagnosing
+                    timer.add(stage.name + "_bg", stage.worker_seconds)
             else:
                 result = stage.wait()
         finally:

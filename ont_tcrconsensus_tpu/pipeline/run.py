@@ -38,6 +38,7 @@ from ont_tcrconsensus_tpu.pipeline import overlap, stages
 from ont_tcrconsensus_tpu.pipeline.config import RunConfig
 from ont_tcrconsensus_tpu.qc import artifacts, umi_overlap
 from ont_tcrconsensus_tpu.qc.timing import StageTimer
+from ont_tcrconsensus_tpu.robustness import faults, retry, shutdown
 
 # fallback precision bar when no reference pair survives the homology filter
 # (the reference would crash there; see cluster/regions.py docstring)
@@ -141,6 +142,22 @@ def _run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]
     from ont_tcrconsensus_tpu.parallel import distributed as dist
 
     enable_compilation_cache()
+    # fault-tolerant execution layer (robustness/): every run DECLARES its
+    # chaos state — the config key wins over the TCR_CHAOS env var, and
+    # with neither present any stale plan from a previous in-process run
+    # is disarmed (a chaos soak must never bleed faults into a later
+    # clean analysis run). Then install the config-derived retry policy
+    # and reset the recorder behind robustness_report.json.
+    if cfg.chaos:
+        faults.arm(cfg.chaos, seed=cfg.chaos_seed)
+    elif faults.arm_from_env() is None:
+        faults.disarm()
+    policy = retry.set_policy(retry.RetryPolicy(
+        max_attempts=cfg.retry_max_attempts,
+        base_delay_s=cfg.retry_base_delay_s,
+    ))
+    recorder = retry.recorder()
+    recorder.reset()
     if cfg.distributed:
         # no-op when already up (e.g. the CLI initialized pre-import);
         # required: a failed bring-up must abort, not degrade to N racing
@@ -266,32 +283,63 @@ def _run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]
 
     results: dict[str, dict[str, int]] = {}
     failed_libraries: list[tuple[str, str]] = []
-    for fastq in fastq_list:
-        # The whole per-library unit is guarded (dir init and resume reload
-        # included): a failed library degrades to a report instead of
-        # aborting the run — and, multi-host, instead of stranding the
-        # peers in the end-of-run collective below (they cannot know this
-        # process would never arrive). Resume retries it: no stage marked.
+    preempted: shutdown.Preempted | None = None
+    # Preemption-safe shutdown: the first SIGTERM/SIGINT requests a stop,
+    # the loop raises Preempted at the next stage-boundary checkpoint, the
+    # per-library guard drains overlapped workers, and the process exits
+    # with every committed checkpoint intact (resume=true continues).
+    coord = shutdown.ShutdownCoordinator()
+    coord.install()  # False off the main thread: cooperative stops only
+    shutdown.activate(coord)
+    try:
+        for fastq in fastq_list:
+            shutdown.checkpoint("run.library_start")
+            # The whole per-library unit is guarded (dir init and resume
+            # reload included): a failed library degrades to a report
+            # instead of aborting the run — and, multi-host, instead of
+            # stranding the peers in the end-of-run collective below (they
+            # cannot know this process would never arrive). Resume retries
+            # it: no stage marked. Preempted derives from BaseException so
+            # this guard can never swallow a shutdown into a skip.
+            try:
+                lay = layout.init_library_dir(fastq, nano_dir, resume=cfg.resume)
+                if cfg.resume and lay.stage_done("counts"):
+                    _log("Library already complete:", lay.library)
+                    counts_csv = os.path.join(lay.counts, "umi_consensus_counts.csv")
+                    results[lay.library] = _read_counts_csv(counts_csv)
+                    continue
+                results[lay.library] = _run_library(
+                    fastq, lay, cfg, panel, engine, engine_notrim,
+                    blast_id_threshold, overlap_consensus, polisher,
+                    read_batch, budget,
+                )
+            except Exception as exc:
+                library = layout.library_name_from_fastq(fastq)
+                failed_libraries.append((library, repr(exc)))
+                _log(f"WARNING: library {library} failed and is skipped: {exc!r}")
+    except shutdown.Preempted as p:
+        preempted = p
+        _log(f"PREEMPTED: {p}; every committed stage checkpoint is "
+             "resume-safe — rerun with resume=true to continue")
+    finally:
+        coord.uninstall()
+        shutdown.deactivate(coord)
         try:
-            lay = layout.init_library_dir(fastq, nano_dir, resume=cfg.resume)
-            if cfg.resume and lay.stage_done("counts"):
-                _log("Library already complete:", lay.library)
-                counts_csv = os.path.join(lay.counts, "umi_consensus_counts.csv")
-                results[lay.library] = _read_counts_csv(counts_csv)
-                continue
-            results[lay.library] = _run_library(
-                fastq, lay, cfg, panel, engine, engine_notrim,
-                blast_id_threshold, overlap_consensus, polisher,
-                read_batch, budget,
-            )
-        except Exception as exc:
-            library = layout.library_name_from_fastq(fastq)
-            failed_libraries.append((library, repr(exc)))
-            _log(f"WARNING: library {library} failed and is skipped: {exc!r}")
+            recorder.write(os.path.join(
+                nano_dir,
+                "robustness_report.json" if n_proc == 1
+                else f"robustness_report_p{proc_id}.json",
+            ), policy=policy)
+        except OSError as exc:  # report trouble must never mask the run's fate
+            _log(f"WARNING: could not write robustness report: {exc!r}")
     if failed_libraries:
         with open(os.path.join(nano_dir, f"failed_libraries_p{proc_id}.log"), "w") as fh:
             for library, err in failed_libraries:
                 fh.write(f"{library}\t{err}\n")
+    if preempted is not None:
+        # multi-host: peers receive the same preemption signal; skipping
+        # the allgather here avoids parking a dying host in a collective
+        raise preempted
     if n_proc > 1:
         # gather counts AND failure markers so every host sees the same
         # global picture — a failure on one shard must fail the whole run
@@ -344,13 +392,35 @@ def _commit_pending_qc(qc_exec, pending_qc, timer) -> None:
     point sits BEFORE the stage checkpoint that would let resume skip the
     producing round — a crash between compute and commit therefore leaves
     the round unmarked and resume regenerates the artifact, exactly like
-    the serial run."""
+    the serial run.
+
+    A worker that died of a TRANSIENT fault (thread killed, device
+    connection dropped) is recomputed synchronously on the main thread —
+    the inputs are immutable columnar blocks, so the artifact is
+    byte-identical and only the overlap is lost; deterministic failures
+    propagate exactly as before."""
     if not pending_qc:
         return
     from ont_tcrconsensus_tpu.qc import error_profile
 
     for stage, log_path in pending_qc:
-        counters = qc_exec.commit(stage, timer)
+        try:
+            counters = qc_exec.commit(stage, timer)
+        except Exception as exc:
+            cls = retry.classify(exc)
+            rec = retry.recorder()
+            if cls == "fatal":
+                rec.record("overlap.worker", classification=cls,
+                           outcome="fatal", error=repr(exc))
+                raise
+            rec.record("overlap.worker", classification=cls,
+                       outcome="retried", error=repr(exc))
+            _log(f"WARNING: overlapped stage {stage.name} hit a {cls} "
+                 f"fault ({exc!r}); recomputing on the main thread")
+            with timer.stage(stage.name):
+                counters = stage.rerun_sync()
+            rec.record("overlap.worker", classification=cls,
+                       outcome="recovered", attempt=2)
         error_profile.write_error_profile_log(*counters, log_path)
         _log(f"qc: {stage.name} computed off the critical path "
              f"({stage.worker_seconds:.1f}s overlapped)")
@@ -380,16 +450,23 @@ def _run_library_impl(fastq, lay, cfg, panel, engine, engine_notrim,
     # minimap2_align.py:76-155 + region_split.py:219-333 + extract_umis.py)
     _log("Preprocessing, aligning and UMI-tagging nanopore reads:", library)
     with timer.stage("round1_fused_assign"):
-        store, astats = stages.run_assign(
-            fastq, engine,
-            max_ee_rate=cfg.max_ee_rate_base,
-            min_len=cfg.minimal_length,
-            minimal_region_overlap=cfg.minimal_region_overlap,
-            max_softclip_5_end=cfg.max_softclip_5_end,
-            max_softclip_3_end=cfg.max_softclip_3_end,
-            batch_size=read_batch,
-            max_read_length=cfg.max_read_length,
-            subsample=cfg.dorado_trim_subsample_fastq,
+        # transient-retry wrap: the fused pass is idempotent (it streams
+        # the fastq into a fresh store), so a dropped device connection
+        # mid-library re-runs the whole pass instead of skipping the
+        # library (robustness/retry.py classification)
+        store, astats = retry.call_with_retry(
+            "assign.round1",
+            lambda: stages.run_assign(
+                fastq, engine,
+                max_ee_rate=cfg.max_ee_rate_base,
+                min_len=cfg.minimal_length,
+                minimal_region_overlap=cfg.minimal_region_overlap,
+                max_softclip_5_end=cfg.max_softclip_5_end,
+                max_softclip_3_end=cfg.max_softclip_3_end,
+                batch_size=read_batch,
+                max_read_length=cfg.max_read_length,
+                subsample=cfg.dorado_trim_subsample_fastq,
+            ),
         )
     with open(os.path.join(lay.logs, "ee_filter.log"), "w") as fh:
         fh.write(
@@ -476,8 +553,9 @@ def _run_library_impl(fastq, lay, cfg, panel, engine, engine_notrim,
 
     grouped = None
     with timer.stage("round1_umi_cluster"):
-        try:
-            grouped = stages.cluster_and_select_grouped(
+        def _batched_r1():
+            faults.inject("cluster.batched_round1")
+            return stages.cluster_and_select_grouped(
                 records_by_group,
                 identity=cfg.vsearch_identity,
                 min_umi_length=cfg.min_umi_length,
@@ -487,7 +565,17 @@ def _run_library_impl(fastq, lay, cfg, panel, engine, engine_notrim,
                 balance_strands=cfg.balance_strands,
                 mesh=engine.mesh,
             )
+
+        try:
+            # transients retry the batched pass; a deterministic failure
+            # (or an exhausted policy) degrades to the per-group retry
+            # loop below so one bad group cannot poison its peers
+            grouped = retry.call_with_retry("cluster.batched_round1", _batched_r1)
         except Exception as exc:
+            retry.recorder().record(
+                "cluster.batched_round1", classification=retry.classify(exc),
+                outcome="degraded", error=repr(exc),
+            )
             _log(f"WARNING: batched UMI clustering failed ({exc!r}); "
                  "retrying each region cluster individually")
     for group_name, umis in records_by_group:
@@ -557,6 +645,11 @@ def _run_library_impl(fastq, lay, cfg, panel, engine, engine_notrim,
         # incomplete round 1 is NOT checkpointed: resume must retry the
         # failed groups instead of reusing a consensus missing them
         lay.mark_stage_done("round1_consensus")
+    # chaos site + preemption checkpoint at the round-1 commit: the
+    # canonical mid-stage death — the manifest just committed, so a kill
+    # here resumes into round 2 only, byte-identically
+    faults.inject("run.round1_checkpoint")
+    shutdown.checkpoint("run.round1_checkpoint")
     return _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
                        overlap_consensus, merged_consensus, timer,
                        read_batch, budget,
@@ -640,18 +733,24 @@ def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
             _log(f"round 2: targeted assign unavailable ({why_not}); "
                  "falling back to the full fused assign")
     with timer.stage("round2_fused_assign"):
-        cons_store, cstats = stages.run_assign(
-            cons_records, engine_notrim,
-            max_ee_rate=1.0,  # no quality data on consensus sequences
-            min_len=1,
-            minimal_region_overlap=overlap_consensus,
-            max_softclip_5_end=cfg.max_softclip_5_end,
-            max_softclip_3_end=cfg.max_softclip_3_end,
-            batch_size=read_batch,
-            max_read_length=cfg.max_read_length,
-            blast_id_threshold=blast_id_threshold,
-            collect_qc=qc_rows,
-            dispatch=dispatch,
+        # transient-retry wrap like round 1; qc_rows is cleared before
+        # each retry so a half-consumed attempt cannot duplicate QC rows
+        cons_store, cstats = retry.call_with_retry(
+            "assign.round2",
+            lambda: stages.run_assign(
+                cons_records, engine_notrim,
+                max_ee_rate=1.0,  # no quality data on consensus sequences
+                min_len=1,
+                minimal_region_overlap=overlap_consensus,
+                max_softclip_5_end=cfg.max_softclip_5_end,
+                max_softclip_3_end=cfg.max_softclip_3_end,
+                batch_size=read_batch,
+                max_read_length=cfg.max_read_length,
+                blast_id_threshold=blast_id_threshold,
+                collect_qc=qc_rows,
+                dispatch=dispatch,
+            ),
+            reset=qc_rows.clear,
         )
     artifacts.write_consensus_filter_artifacts(
         qc_rows,
@@ -720,8 +819,9 @@ def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
 
     grouped2 = None
     with timer.stage("round2_umi_cluster"):
-        try:
-            grouped2 = stages.cluster_and_select_grouped(
+        def _batched_r2():
+            faults.inject("cluster.batched_round2")
+            return stages.cluster_and_select_grouped(
                 region_records,
                 identity=cfg.vsearch_identity_consensus,
                 min_umi_length=cfg.min_umi_length,
@@ -731,7 +831,14 @@ def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
                 balance_strands=False,
                 mesh=engine_notrim.mesh,
             )
+
+        try:
+            grouped2 = retry.call_with_retry("cluster.batched_round2", _batched_r2)
         except Exception as exc:
+            retry.recorder().record(
+                "cluster.batched_round2", classification=retry.classify(exc),
+                outcome="degraded", error=repr(exc),
+            )
             _log(f"WARNING: batched round-2 UMI clustering failed ({exc!r}); "
                  "retrying each region individually")
     for region, umis in region_records:

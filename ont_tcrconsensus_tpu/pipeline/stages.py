@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from collections import defaultdict
 
 import numpy as np
@@ -21,6 +22,7 @@ from ont_tcrconsensus_tpu.cluster import umi as umi_mod
 from ont_tcrconsensus_tpu.io import bucketing, fastx
 from ont_tcrconsensus_tpu.ops import consensus as consensus_mod
 from ont_tcrconsensus_tpu.ops import encode
+from ont_tcrconsensus_tpu.robustness import faults, retry
 from ont_tcrconsensus_tpu.pipeline.assign import (  # noqa: F401  (re-exported)
     AlignStats,
     AssignEngine,
@@ -707,6 +709,12 @@ def polish_clusters_all(
             prepared[(s_bucket, width)].append(
                 (group_name, cl, codes, lens, quals, strands)
             )
+    n_data = None
+    if mesh is not None:
+        # the cluster axis shards over 'data': chunks must divide it
+        from ont_tcrconsensus_tpu.parallel.mesh import mesh_data_size
+
+        n_data = mesh_data_size(mesh)
     for (s_bucket, width), items in sorted(prepared.items()):
         # Band scales with the width bucket: +/-32 is >4 sigma of same-
         # molecule drift up to ~2 kb, but cumulative indel drift grows with
@@ -728,59 +736,155 @@ def polish_clusters_all(
         # library padded to the full HBM tile wastes most of the dispatch);
         # power-of-two so compile shapes stay bounded
         cb = min(cb, bucketing.pow2_ceil(len(items)))
-        if mesh is not None:
-            # the cluster axis shards over 'data': chunks must divide it
-            from ont_tcrconsensus_tpu.parallel.mesh import mesh_data_size
-
-            n_data = mesh_data_size(mesh)
+        if n_data is not None:
             cb = max(cb, n_data)
-        for start in range(0, len(items), cb):
-            chunk = items[start : start + cb]
-            C = len(chunk)
-            try:
-                sub = np.stack([codes for _, _, codes, _, _, _ in chunk])
-                lens = np.stack([ln for _, _, _, ln, _, _ in chunk])
-                have_quals = all(q is not None for _, _, _, _, q, _ in chunk)
-                quals = (np.stack([q for _, _, _, _, q, _ in chunk])
-                         if have_quals else None)
-                strands = np.stack([s for _, _, _, _, _, s in chunk])
-                if C < cb:  # pad the cluster axis: stable compile shapes
-                    pad = cb - C
-                    sub = np.concatenate(
-                        [sub, np.full((pad, s_bucket, width), encode.PAD_CODE, np.uint8)]
-                    )
-                    lens = np.concatenate([lens, np.zeros((pad, s_bucket), lens.dtype)])
-                    if quals is not None:
-                        quals = np.concatenate(
-                            [quals, np.zeros((pad, s_bucket, width), np.uint8)]
+        # Fault-tolerant chunk drive (robustness/): transient device faults
+        # retry the SAME shape under the bounded-backoff policy; a
+        # RESOURCE_EXHAUSTED re-enters the HBM budget with a halved
+        # allowance and requeues the chunk at the smaller cluster batch
+        # (degrade, don't skip — the work still completes); anything else
+        # is a deterministic bug and falls through to the existing
+        # skip-and-report path. With no fault firing this walks the exact
+        # chunk sequence of the plain loop, so outputs are byte-identical.
+        worklist: list[tuple[list, int, int]] = [(items, cb, 0)]
+        while worklist:
+            run_items, cb_run, shrink = worklist.pop(0)
+            requeued = False
+            for start in range(0, len(run_items), cb_run):
+                chunk = run_items[start : start + cb_run]
+                seqs = None
+                attempt = 1
+                while True:
+                    try:
+                        faults.inject("polish.dispatch")
+                        seqs = _dispatch_polish_chunk(
+                            chunk, cb_run, s_bucket, width, rounds=rounds,
+                            eff_band=eff_band, keep_pos=keep_pos,
+                            polisher=polisher, mesh=mesh,
                         )
-                    strands = np.concatenate(
-                        [strands, np.zeros((pad, s_bucket), bool)]
+                    except Exception as exc:
+                        pol, rec = retry.policy(), retry.recorder()
+                        cls = retry.classify(exc)
+                        if cls == "transient" and attempt < pol.max_attempts:
+                            rec.record("polish.dispatch", classification=cls,
+                                       outcome="retried", attempt=attempt,
+                                       error=repr(exc))
+                            time.sleep(pol.delay(attempt))
+                            attempt += 1
+                            continue
+                        if cls == "oom":
+                            new_cb = _shrunken_cluster_batch(
+                                budget, shrink, s_bucket, width, eff_band,
+                                keep_final=polisher is not None,
+                                keep_pos=keep_pos, cb_run=cb_run,
+                            )
+                            if n_data is not None:
+                                new_cb = max(new_cb, n_data)
+                            if new_cb < cb_run:
+                                rec.record(
+                                    "polish.dispatch", classification="oom",
+                                    outcome="oom_shrink", attempt=attempt,
+                                    error=repr(exc),
+                                    detail={"cluster_batch_from": cb_run,
+                                            "cluster_batch_to": new_cb,
+                                            "shrink_level": shrink + 1},
+                                )
+                                # requeue the failing chunk AND the untried
+                                # remainder at the smaller batch: HBM is
+                                # exhausted, so every further dispatch at
+                                # cb_run is a guaranteed repeat OOM (final
+                                # per-group sort keeps output order exact)
+                                worklist.append(
+                                    (run_items[start:], new_cb, shrink + 1)
+                                )
+                                requeued = True
+                                break
+                        rec.record("polish.dispatch", classification=cls,
+                                   outcome="degraded", attempt=attempt,
+                                   error=repr(exc))
+                        for group_name, *_ in chunk:
+                            failed.setdefault(group_name, repr(exc))
+                        break
+                    else:
+                        if attempt > 1 or shrink:
+                            retry.recorder().record(
+                                "polish.dispatch",
+                                classification="oom" if shrink else "transient",
+                                outcome="recovered", attempt=attempt,
+                                detail=({"shrink_level": shrink}
+                                        if shrink else None),
+                            )
+                        break
+                if requeued:
+                    break
+                if seqs is None:
+                    continue
+                for c, seq in enumerate(seqs):
+                    group_name, cl = chunk[c][0], chunk[c][1]
+                    by_group[group_name].append(
+                        (f"{group_name}_cluster{cl.cluster_id}_{len(cl.members)}", seq)
                     )
-                drafts, dlens, *rest = consensus_mod.consensus_clusters_batch(
-                    sub, lens, rounds=rounds, band_width=eff_band,
-                    keep_final_pileup=polisher is not None,
-                    keep_pos=keep_pos, mesh=mesh,
-                )
-                if polisher is not None:
-                    drafts, dlens = polisher(
-                        sub, lens, drafts, dlens, pileup=rest[0],
-                        band_width=eff_band, mesh=mesh,
-                        quals=quals, strands=strands,
-                    )
-                seqs = encode.decode_batch(drafts[:C], dlens[:C])
-            except Exception as exc:
-                for group_name, *_ in chunk:
-                    failed.setdefault(group_name, repr(exc))
-                continue
-            for c in range(C):
-                group_name, cl = chunk[c][0], chunk[c][1]
-                by_group[group_name].append(
-                    (f"{group_name}_cluster{cl.cluster_id}_{len(cl.members)}", seqs[c])
-                )
     for entries in by_group.values():
         entries.sort(key=lambda kv: int(kv[0].rsplit("_cluster", 1)[1].split("_")[0]))
     return by_group, failed
+
+
+def _dispatch_polish_chunk(chunk, cb, s_bucket, width, *, rounds, eff_band,
+                           keep_pos, polisher, mesh) -> list[str]:
+    """One (C<=cb, S, W) consensus+polish device dispatch; returns the C
+    decoded sequences in chunk order. Pure function of its inputs — safe
+    to retry verbatim after a transient fault or at a smaller ``cb``
+    after an OOM."""
+    C = len(chunk)
+    sub = np.stack([codes for _, _, codes, _, _, _ in chunk])
+    lens = np.stack([ln for _, _, _, ln, _, _ in chunk])
+    have_quals = all(q is not None for _, _, _, _, q, _ in chunk)
+    quals = (np.stack([q for _, _, _, _, q, _ in chunk])
+             if have_quals else None)
+    strands = np.stack([s for _, _, _, _, _, s in chunk])
+    if C < cb:  # pad the cluster axis: stable compile shapes
+        pad = cb - C
+        sub = np.concatenate(
+            [sub, np.full((pad, s_bucket, width), encode.PAD_CODE, np.uint8)]
+        )
+        lens = np.concatenate([lens, np.zeros((pad, s_bucket), lens.dtype)])
+        if quals is not None:
+            quals = np.concatenate(
+                [quals, np.zeros((pad, s_bucket, width), np.uint8)]
+            )
+        strands = np.concatenate(
+            [strands, np.zeros((pad, s_bucket), bool)]
+        )
+    drafts, dlens, *rest = consensus_mod.consensus_clusters_batch(
+        sub, lens, rounds=rounds, band_width=eff_band,
+        keep_final_pileup=polisher is not None,
+        keep_pos=keep_pos, mesh=mesh,
+    )
+    if polisher is not None:
+        drafts, dlens = polisher(
+            sub, lens, drafts, dlens, pileup=rest[0],
+            band_width=eff_band, mesh=mesh,
+            quals=quals, strands=strands,
+        )
+    return encode.decode_batch(drafts[:C], dlens[:C])
+
+
+def _shrunken_cluster_batch(budget, shrink, s_bucket, width, eff_band, *,
+                            keep_final, keep_pos, cb_run) -> int:
+    """Next cluster batch after the ``shrink``-th OOM at ``cb_run``:
+    re-derive from the budget model with a halved HBM allowance (the
+    medaka memory model run in reverse), clamped strictly below ``cb_run``
+    with a floor of 1 so the shrink sequence always terminates."""
+    if budget is not None:
+        shrunk = dataclasses.replace(
+            budget, hbm_gb=budget.hbm_gb / (2.0 ** (shrink + 1))
+        )
+        new_cb = shrunk.cluster_batch(s_bucket, width, eff_band,
+                                      keep_final_pileup=keep_final,
+                                      keep_pos=keep_pos)
+    else:
+        new_cb = cb_run // 2
+    return max(1, min(new_cb, cb_run // 2))
 
 
 def polish_clusters_stage(

@@ -1,0 +1,23 @@
+"""Fault-tolerant execution layer.
+
+Three cooperating pieces, each a process-wide singleton so the deep call
+sites (stages.py chunk loops, overlap.py workers, layout.py manifest
+writes) need no config plumbing:
+
+- :mod:`.faults` — deterministic fault-injection registry. Named injection
+  points are planted at the pipeline's dispatch/commit/checkpoint sites;
+  a chaos plan (config ``chaos`` key or ``TCR_CHAOS`` env JSON) arms
+  specific faults at specific hit counts. Disarmed cost is one module
+  attribute check per site.
+- :mod:`.retry` — failure classification (transient device error vs HBM
+  OOM vs deterministic bug), bounded exponential-backoff-plus-jitter
+  retry, and the :class:`~.retry.RobustnessRecorder` behind the
+  ``robustness_report.json`` artifact.
+- :mod:`.shutdown` — preemption-safe SIGTERM/SIGINT handling: the first
+  signal requests a stop, the pipeline raises :class:`~.shutdown.Preempted`
+  at the next stage boundary, drains overlapped workers, and exits with
+  every fully-committed checkpoint intact so ``resume=true`` continues
+  byte-identically.
+"""
+
+from ont_tcrconsensus_tpu.robustness import faults, retry, shutdown  # noqa: F401

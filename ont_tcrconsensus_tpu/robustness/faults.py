@@ -1,0 +1,254 @@
+"""Deterministic, seeded fault-injection registry (chaos mode).
+
+Every degradation path in the pipeline used to be dead code: the
+``except`` ladders in run.py were structurally present but nothing ever
+exercised them. This module turns them into tested behavior by letting a
+test (or an operator, via the ``TCR_CHAOS`` env var / the ``chaos`` config
+key) arm named faults at named injection points:
+
+======================== ====================================================
+site                     planted at
+======================== ====================================================
+``assign.dispatch``      the fused-pass batch dispatch loop
+                         (pipeline/assign.py, run_assign)
+``polish.dispatch``      the batched consensus/polish chunk dispatch
+                         (pipeline/stages.py, polish_clusters_all)
+``cluster.batched_round1`` the library-wide batched UMI clustering pass,
+``cluster.batched_round2`` rounds 1 / 2 (pipeline/run.py)
+``overlap.worker``       the background-stage worker body
+                         (pipeline/overlap.py, DeferredStage._run)
+``layout.manifest_write`` the stage-manifest write (io/layout.py) —
+                         ``torn`` kind tears the file mid-write
+``run.round1_checkpoint`` immediately after the round-1 consensus
+                         checkpoint commits (pipeline/run.py) — the
+                         mid-stage ``kill`` / ``preempt`` site
+======================== ====================================================
+
+Fault kinds:
+
+- ``transient`` — raises :class:`TransientChaosError` (classified as a
+  retryable device/transport fault, message carries ``UNAVAILABLE``)
+- ``oom``       — raises :class:`OomChaosError` (classified as HBM
+  exhaustion, message carries ``RESOURCE_EXHAUSTED``)
+- ``error``     — raises a plain ``RuntimeError`` (a deterministic bug:
+  never retried, exercises the skip/degrade paths)
+- ``kill``      — ``os._exit(137)``: unflushable process death, exactly
+  what a preempted VM looks like to the filesystem
+- ``preempt``   — triggers the active shutdown coordinator as if SIGTERM
+  had arrived (the next stage-boundary checkpoint raises ``Preempted``)
+- ``torn``      — only meaningful at write sites driven through
+  :func:`tear_write`: the payload is truncated mid-write, simulating a
+  crash between ``write`` and ``os.replace``
+
+Determinism: a spec fires on exact hit counts (``skip`` pass-throughs,
+then ``times`` fires), or — for soak-style runs — with probability ``p``
+drawn from a generator seeded by ``(plan seed, site)``, so a given plan
+replays identically. Disarmed, :func:`inject` is one global check.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import sys
+import threading
+
+ENV_VAR = "TCR_CHAOS"
+
+KINDS = ("transient", "oom", "error", "kill", "preempt", "torn")
+
+#: every injection point planted in the pipeline; arming an unknown site is
+#: an error so chaos-plan typos fail fast instead of silently never firing
+KNOWN_SITES = frozenset({
+    "assign.dispatch",
+    "polish.dispatch",
+    "cluster.batched_round1",
+    "cluster.batched_round2",
+    "overlap.worker",
+    "layout.manifest_write",
+    "run.round1_checkpoint",
+})
+
+KILL_EXIT_CODE = 137
+
+
+class TransientChaosError(RuntimeError):
+    """Injected transient device/transport fault (retryable)."""
+
+
+class OomChaosError(RuntimeError):
+    """Injected HBM exhaustion (degradable: shrink the batch and retry)."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One armed fault: fire ``times`` times at ``site`` after ``skip``
+    pass-through hits (or i.i.d. with probability ``p`` when set)."""
+
+    site: str
+    kind: str = "transient"
+    skip: int = 0
+    times: int = 1
+    p: float | None = None
+    message: str = ""
+
+    def __post_init__(self):
+        if self.site not in KNOWN_SITES:
+            raise ValueError(
+                f"unknown chaos site {self.site!r}; known: {sorted(KNOWN_SITES)}"
+            )
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown chaos kind {self.kind!r}; known: {KINDS}")
+        if self.p is not None and not (0.0 <= self.p <= 1.0):
+            raise ValueError(f"chaos p={self.p} outside [0, 1]")
+
+
+class FaultPlan:
+    """Armed specs + per-site hit/fire counters (thread-safe: injection
+    points sit on worker threads too)."""
+
+    def __init__(self, specs: list[FaultSpec], seed: int = 0):
+        self.specs = list(specs)
+        self.seed = seed
+        self._lock = threading.Lock()
+        self._hits: dict[str, int] = {}
+        self._fired: dict[str, int] = {}
+        self._spec_fired: dict[int, int] = {}
+        self._rng: dict[str, random.Random] = {}
+
+    def hit(self, site: str) -> FaultSpec | None:
+        """Count one arrival at ``site``; return the spec to fire, if any."""
+        with self._lock:
+            n = self._hits.get(site, 0)
+            self._hits[site] = n + 1
+            for i, spec in enumerate(self.specs):
+                if spec.site != site:
+                    continue
+                fired = self._spec_fired.get(i, 0)
+                if spec.times > 0 and fired >= spec.times:
+                    continue
+                if spec.p is not None:
+                    rng = self._rng.setdefault(
+                        site, random.Random(f"{self.seed}:{site}")
+                    )
+                    if rng.random() >= spec.p:
+                        continue
+                elif n < spec.skip:
+                    continue
+                self._spec_fired[i] = fired + 1
+                self._fired[site] = self._fired.get(site, 0) + 1
+                return spec
+            return None
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {
+                "seed": self.seed,
+                "specs": [dataclasses.asdict(s) for s in self.specs],
+                "hits": dict(self._hits),
+                "fired": dict(self._fired),
+            }
+
+
+_PLAN: FaultPlan | None = None
+
+
+def active() -> bool:
+    return _PLAN is not None
+
+
+def arm(specs, seed: int = 0) -> FaultPlan:
+    """Arm a chaos plan from a list of spec dicts (or FaultSpecs)."""
+    global _PLAN
+    parsed = [
+        s if isinstance(s, FaultSpec) else FaultSpec(**s) for s in specs
+    ]
+    _PLAN = FaultPlan(parsed, seed=seed)
+    return _PLAN
+
+
+def arm_from_env() -> FaultPlan | None:
+    """Arm a FRESH plan from the ``TCR_CHAOS`` env JSON (a spec list, or
+    ``{"seed": n, "faults": [...]}``); returns None — leaving any current
+    plan untouched — when the variable is unset. Each pipeline run
+    re-declares its chaos state (run.py), so an env-armed plan fires anew
+    per run and never silently bleeds exhausted counters across runs."""
+    raw = os.environ.get(ENV_VAR)
+    if not raw:
+        return None
+    data = json.loads(raw)
+    if isinstance(data, dict):
+        return arm(data.get("faults", []), seed=int(data.get("seed", 0)))
+    return arm(data)
+
+
+def disarm() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+def describe() -> dict | None:
+    return _PLAN.describe() if _PLAN is not None else None
+
+
+def fired(site: str) -> int:
+    """How many times any spec fired at ``site`` (0 when disarmed)."""
+    if _PLAN is None:
+        return 0
+    with _PLAN._lock:
+        return _PLAN._fired.get(site, 0)
+
+
+def _fire(spec: FaultSpec, site: str) -> None:
+    msg = spec.message or f"injected {spec.kind} fault at {site}"
+    if spec.kind == "transient":
+        raise TransientChaosError(f"UNAVAILABLE: {msg}")
+    if spec.kind == "oom":
+        raise OomChaosError(f"RESOURCE_EXHAUSTED: {msg}")
+    if spec.kind == "error":
+        raise RuntimeError(msg)
+    if spec.kind == "kill":
+        # a preempted VM does not flush buffers or run atexit hooks;
+        # os._exit is the honest simulation of that
+        sys.stderr.write(f"CHAOS: killing process at {site}\n")
+        sys.stderr.flush()
+        os._exit(KILL_EXIT_CODE)
+    if spec.kind == "preempt":
+        from ont_tcrconsensus_tpu.robustness import shutdown
+
+        shutdown.request(reason=f"chaos preempt at {site}")
+        return
+    raise AssertionError(f"unhandled chaos kind {spec.kind!r}")  # pragma: no cover
+
+
+def inject(site: str) -> None:
+    """Raise/kill/preempt per the armed plan; free no-op when disarmed."""
+    if _PLAN is None:
+        return
+    spec = _PLAN.hit(site)
+    if spec is not None:
+        _fire(spec, site)
+
+
+def tear_write(site: str, path: str, payload: str) -> bool:
+    """Torn-write injection for file-commit sites.
+
+    Returns True when a ``torn`` fault fired: the first half of ``payload``
+    was written DIRECTLY to ``path`` (no tmp + rename), simulating a crash
+    mid-write — the caller must skip its own atomic write. Other armed
+    kinds at the site fire through :func:`_fire` as usual.
+    """
+    if _PLAN is None:
+        return False
+    spec = _PLAN.hit(site)
+    if spec is None:
+        return False
+    if spec.kind != "torn":
+        _fire(spec, site)
+        return False
+    with open(path, "w") as fh:
+        fh.write(payload[: max(1, len(payload) // 2)])
+    sys.stderr.write(f"CHAOS: tore write of {path} at {site}\n")
+    return True

@@ -1,0 +1,227 @@
+"""Failure classification + bounded retry policy + the robustness report.
+
+On a real TPU fleet three failure families reach the pipeline's dispatch
+sites, and they want three different answers:
+
+- **transient** device/transport faults (XLA ``UNAVAILABLE`` /
+  ``DEADLINE_EXCEEDED`` / ``ABORTED``, dropped tunnel connections, torn
+  RPCs): retry the same dispatch with bounded exponential backoff — the
+  work is deterministic, so a successful retry is byte-identical.
+- **oom** (``RESOURCE_EXHAUSTED``, HBM exhaustion): retrying the same
+  shape fails forever; the caller must shrink the batch (re-enter
+  parallel/budget.py with a smaller budget) and retry the smaller shape.
+- **fatal** (everything else — a deterministic bug): never retry; fall
+  through to the existing skip-and-report degradation immediately.
+
+Every classify/retry/degrade decision is recorded by the process-wide
+:class:`RobustnessRecorder` and written to ``robustness_report.json`` next
+to the run's other QC artifacts, so "the pipeline recovered" is an
+auditable claim, not a log line that scrolled away.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import threading
+import time
+
+from ont_tcrconsensus_tpu.robustness import faults
+
+#: substrings marking an exception as HBM/host memory exhaustion. Checked
+#: BEFORE the transient markers: XLA OOM messages often also mention the
+#: allocator/transfer machinery.
+OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "resource_exhausted",
+    "out of memory",
+    "Out of memory",
+    "OOM",
+    "hbm",
+    "HBM",
+)
+
+#: substrings marking an exception as a retryable device/transport fault
+TRANSIENT_MARKERS = (
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "CANCELLED",
+    "connection reset",
+    "Connection reset",
+    "socket closed",
+    "Socket closed",
+    "transfer to device",
+    "device to host",
+    "premature end of",
+)
+
+
+def classify(exc: BaseException) -> str:
+    """``"transient" | "oom" | "fatal"`` for an exception from a dispatch
+    site. Unknown exceptions are fatal: retrying a deterministic bug only
+    burns the retry budget and delays the skip-and-report degradation."""
+    if isinstance(exc, faults.OomChaosError) or isinstance(exc, MemoryError):
+        return "oom"
+    if isinstance(exc, faults.TransientChaosError):
+        return "transient"
+    if isinstance(exc, (ConnectionError, TimeoutError, BrokenPipeError)):
+        return "transient"
+    msg = f"{type(exc).__name__}: {exc}"
+    if any(m in msg for m in OOM_MARKERS):
+        return "oom"
+    if any(m in msg for m in TRANSIENT_MARKERS):
+        return "transient"
+    return "fatal"
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff with deterministic seeded jitter.
+
+    ``max_attempts`` counts the first try: 3 means one dispatch plus at
+    most two retries. Jitter decorrelates a fleet of workers retrying the
+    same stalled service, but stays a pure function of ``(seed, attempt)``
+    so a replayed run waits identically.
+    """
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.1
+    max_delay_s: float = 5.0
+    jitter: float = 0.25
+    seed: int = 0
+
+    def delay(self, attempt: int) -> float:
+        """Seconds to wait after failed attempt ``attempt`` (1-based)."""
+        d = min(self.base_delay_s * (2.0 ** (attempt - 1)), self.max_delay_s)
+        if self.jitter:
+            rng = random.Random(f"{self.seed}:{attempt}")
+            d *= 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
+        return d
+
+
+class RobustnessRecorder:
+    """Per-site attempt/outcome counters + the event log behind
+    ``robustness_report.json``. Thread-safe: overlapped QC commits and the
+    polish chunk loop record concurrently."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events: list[dict] = []
+
+    def reset(self) -> None:
+        with self._lock:
+            self.events = []
+
+    def record(self, site: str, *, classification: str, outcome: str,
+               attempt: int = 1, error: str = "", detail: dict | None = None) -> None:
+        ev = {
+            "site": site,
+            "attempt": attempt,
+            "classification": classification,
+            "outcome": outcome,
+        }
+        if error:
+            ev["error"] = error
+        if detail:
+            ev["detail"] = detail
+        with self._lock:
+            self.events.append(ev)
+
+    def summary(self) -> dict:
+        """{site: {attempts, by_classification, by_outcome}} aggregates."""
+        out: dict[str, dict] = {}
+        with self._lock:
+            events = list(self.events)
+        for ev in events:
+            s = out.setdefault(ev["site"], {
+                "events": 0, "by_classification": {}, "by_outcome": {},
+            })
+            s["events"] += 1
+            for key, field in (("by_classification", "classification"),
+                               ("by_outcome", "outcome")):
+                v = ev[field]
+                s[key][v] = s[key].get(v, 0) + 1
+        return out
+
+    def write(self, path: str, policy: "RetryPolicy | None" = None) -> None:
+        with self._lock:
+            events = list(self.events)
+        report = {
+            "policy": dataclasses.asdict(policy) if policy is not None else None,
+            "chaos": faults.describe(),
+            "sites": self.summary(),
+            "events": events,
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(report, fh, indent=1)
+        os.replace(tmp, path)
+
+
+# process-wide active policy/recorder: the deep dispatch sites (stages.py
+# chunk loops, overlap commits) reach them without signature plumbing;
+# run.py swaps in the config-derived policy at run start
+_RECORDER = RobustnessRecorder()
+_POLICY = RetryPolicy()
+
+
+def recorder() -> RobustnessRecorder:
+    return _RECORDER
+
+
+def policy() -> RetryPolicy:
+    return _POLICY
+
+
+def set_policy(p: RetryPolicy) -> RetryPolicy:
+    global _POLICY
+    _POLICY = p
+    return p
+
+
+def call_with_retry(site: str, fn, *, policy: RetryPolicy | None = None,
+                    recorder: RobustnessRecorder | None = None,
+                    sleep=time.sleep, reset=None):
+    """Run ``fn()`` under the transient-retry policy.
+
+    ONLY transient failures back off and retry (up to
+    ``policy.max_attempts`` total attempts). Fatal failures raise
+    immediately, and so do oom failures: these call sites have no
+    shrinkable batch, so re-dispatching the identical shape into an
+    exhausted HBM is guaranteed to fail again — the caller's degradation
+    path (skip/fallback) is the right answer, not burned retries (sites
+    WITH a shrinkable batch, like the polish chunk loop, run their own
+    shrink-and-requeue loop instead). ``reset`` runs before every retry so
+    the callable can clear partial side effects (e.g. a half-filled QC row
+    list). The last failure re-raises when the budget is exhausted —
+    callers keep their existing degradation paths.
+    """
+    pol = policy if policy is not None else _POLICY
+    rec = recorder if recorder is not None else _RECORDER
+    attempt = 1
+    while True:
+        try:
+            result = fn()
+        except Exception as exc:
+            cls = classify(exc)
+            if cls != "transient" or attempt >= pol.max_attempts:
+                rec.record(site, classification=cls,
+                           outcome=("fatal" if cls == "fatal"
+                                    else "not_retryable" if cls == "oom"
+                                    else "exhausted"),
+                           attempt=attempt, error=repr(exc))
+                raise
+            rec.record(site, classification=cls, outcome="retried",
+                       attempt=attempt, error=repr(exc))
+            sleep(pol.delay(attempt))
+            attempt += 1
+            if reset is not None:
+                reset()
+        else:
+            if attempt > 1:
+                rec.record(site, classification="transient",
+                           outcome="recovered", attempt=attempt)
+            return result

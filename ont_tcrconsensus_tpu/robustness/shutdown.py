@@ -1,0 +1,156 @@
+"""Preemption-safe shutdown: SIGTERM/SIGINT -> drain -> resumable exit.
+
+Preemptible TPU VMs get SIGTERM with a short grace window. Dying mid-write
+is already survivable (the manifest only marks FULLY complete stages, and
+io/layout.py commits it atomically), but an uncontrolled death wastes the
+whole in-flight library and can leave overlapped QC workers' failures
+unreported. The coordinator turns the signal into a cooperative stop:
+
+1. the first SIGTERM/SIGINT sets a flag (and logs); work in progress is
+   NOT interrupted mid-dispatch,
+2. the pipeline polls :func:`checkpoint` at stage boundaries and raises
+   :class:`Preempted` at the first one after the flag,
+3. the per-library guard in run.py drains the overlap executor's
+   background stages (its existing BaseException path), the driver writes
+   the robustness report, and the process exits with every committed
+   checkpoint intact — ``resume=true`` continues byte-identically,
+4. a second signal restores the default disposition and re-delivers, for
+   operators who really mean "now".
+
+:class:`Preempted` derives from ``BaseException`` on purpose: the
+per-library ``except Exception`` degradation guard must never swallow a
+preemption into "library failed, skipped".
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+
+
+class Preempted(BaseException):
+    """Raised at a stage-boundary checkpoint after a shutdown request."""
+
+    def __init__(self, reason: str, site: str = ""):
+        self.reason = reason
+        self.site = site
+        super().__init__(f"{reason} (observed at {site or 'checkpoint'})")
+
+
+class ShutdownCoordinator:
+    """Installable SIGTERM/SIGINT-to-checkpoint bridge (context manager)."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self):
+        self._flag = threading.Event()
+        self._reason: str | None = None
+        self._previous: dict[int, object] = {}
+        self._installed = False
+        self._signals_seen = 0
+
+    # --- request side -----------------------------------------------------
+
+    def request(self, reason: str) -> None:
+        """Ask for a stop at the next checkpoint (signal-handler and
+        chaos-injection entry point; safe from any thread)."""
+        self._reason = self._reason or reason
+        self._flag.set()
+
+    def _on_signal(self, signum, frame) -> None:
+        # count REAL signals separately from cooperative requests (chaos
+        # preempt, request()): the first actual SIGTERM after a cooperative
+        # stop must still take the drain path, not the kill-now escalation
+        self._signals_seen += 1
+        if self._signals_seen > 1:
+            # second signal: the operator means NOW — restore defaults and
+            # re-deliver so the default disposition (terminate) applies
+            sys.stderr.write(
+                f"shutdown: second signal {signum}; exiting immediately\n"
+            )
+            self.uninstall()
+            os.kill(os.getpid(), signum)
+            return
+        sys.stderr.write(
+            f"shutdown: signal {signum} received; draining to the next "
+            "stage boundary (resume=true continues this run)\n"
+        )
+        self.request(f"signal {signum}")
+
+    # --- poll side --------------------------------------------------------
+
+    def requested(self) -> bool:
+        return self._flag.is_set()
+
+    def checkpoint(self, site: str) -> None:
+        if self._flag.is_set():
+            raise Preempted(self._reason or "shutdown requested", site)
+
+    # --- installation -----------------------------------------------------
+
+    def install(self) -> bool:
+        """Register handlers; False when not on the main thread (signal
+        registration is main-thread-only — worker-thread pipelines still
+        get cooperative stops via :func:`request`)."""
+        if self._installed:
+            return True
+        try:
+            for sig in self.SIGNALS:
+                self._previous[sig] = signal.signal(sig, self._on_signal)
+        except ValueError:  # not the main thread
+            self._previous.clear()
+            return False
+        self._installed = True
+        return True
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for sig, prev in self._previous.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, TypeError):
+                pass
+        self._previous.clear()
+        self._installed = False
+
+    def __enter__(self) -> "ShutdownCoordinator":
+        self.install()
+        return activate(self)
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+        deactivate(self)
+
+
+# process-wide active coordinator, mirroring faults/retry: deep stage code
+# polls checkpoints without plumbing the coordinator through signatures
+_ACTIVE: ShutdownCoordinator | None = None
+
+
+def activate(coord: ShutdownCoordinator) -> ShutdownCoordinator:
+    global _ACTIVE
+    _ACTIVE = coord
+    return coord
+
+
+def deactivate(coord: ShutdownCoordinator | None = None) -> None:
+    global _ACTIVE
+    if coord is None or _ACTIVE is coord:
+        _ACTIVE = None
+
+
+def request(reason: str) -> None:
+    """Request a cooperative stop on the active coordinator (no-op when
+    none is active — e.g. library code called outside run.py)."""
+    if _ACTIVE is not None:
+        _ACTIVE.request(reason)
+
+
+def checkpoint(site: str) -> None:
+    """Raise :class:`Preempted` here if a stop was requested; free no-op
+    otherwise (one global check, same discipline as faults.inject)."""
+    if _ACTIVE is not None:
+        _ACTIVE.checkpoint(site)

@@ -1,0 +1,319 @@
+"""Chaos e2e: every registered injection point, faulted + resumed, must
+reproduce the uninterrupted run byte-for-byte.
+
+The contract under test (ISSUE 2 / README "Failure semantics"): for each
+fault the robustness layer either *recovers in-run* (transient retry, OOM
+batch shrink, QC recompute) or *degrades to a resumable state* (fallback,
+torn-manifest tolerance, preemption, process kill) — and in both cases the
+final counts CSV and consensus FASTA are byte-identical to a run where the
+fault never fired, with the retry recorded in robustness_report.json.
+
+Everything here runs on the simulator library; runs inside one pytest
+process share the in-memory jit cache, so each scenario costs seconds.
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from ont_tcrconsensus_tpu.io import fastx, simulator
+from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+from ont_tcrconsensus_tpu.pipeline.run import run_with_config
+from ont_tcrconsensus_tpu.robustness import faults, retry, shutdown
+
+pytestmark = pytest.mark.chaos
+
+COUNTS_CSV = os.path.join("nano_tcr", "barcode01", "counts",
+                          "umi_consensus_counts.csv")
+MERGED_FASTA = os.path.join("nano_tcr", "barcode01", "fasta",
+                            "merged_consensus.fasta")
+MANIFEST = os.path.join("nano_tcr", "barcode01", "stage_manifest.json")
+REPORT = os.path.join("nano_tcr", "robustness_report.json")
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    yield
+    faults.disarm()
+    shutdown.deactivate()
+
+
+@pytest.fixture(scope="module")
+def chaos_lib(tmp_path_factory):
+    """Simulated library + ONE uninterrupted baseline run (the byte-identity
+    reference for every scenario)."""
+    tmp = tmp_path_factory.mktemp("chaos")
+    lib = simulator.simulate_library(
+        seed=23,
+        num_regions=3,
+        molecules_per_region=(2, 3),
+        reads_per_molecule=(5, 8),
+        sub_rate=0.006,
+        ins_rate=0.003,
+        del_rate=0.003,
+        region_len=(700, 850),  # stays in the 1024-width bucket
+    )
+    inputs = tmp / "inputs"
+    (inputs / "fastq_pass" / "barcode01").mkdir(parents=True)
+    fastx.write_fasta(inputs / "reference.fa", lib.reference.items())
+    fastx.write_fastq(
+        inputs / "fastq_pass" / "barcode01" / "barcode01.fastq.gz", lib.reads
+    )
+    baseline = tmp / "baseline"
+    _stage_inputs(inputs, baseline)
+    results = run_with_config(_cfg(baseline))
+    assert results["barcode01"] == lib.true_counts
+    return {
+        "tmp": tmp,
+        "inputs": inputs,
+        "lib": lib,
+        "baseline_artifacts": _artifact_bytes(baseline),
+        "baseline_counts": results["barcode01"],
+    }
+
+
+def _stage_inputs(inputs, root):
+    root.mkdir(parents=True, exist_ok=True)
+    shutil.copy(inputs / "reference.fa", root / "reference.fa")
+    shutil.copytree(inputs / "fastq_pass", root / "fastq_pass")
+
+
+def _cfg(root, **overrides) -> RunConfig:
+    d = {
+        "reference_file": str(root / "reference.fa"),
+        "fastq_pass_dir": str(root / "fastq_pass"),
+        "minimal_length": 600,
+        "min_reads_per_cluster": 4,
+        "read_batch_size": 64,
+        "polish_method": "poa",
+        "delete_tmp_files": False,
+        "hbm_budget_gb": 12.0,       # deterministic budget-derived batches
+        "retry_base_delay_s": 0.0,   # no wall-clock tax on test retries
+    }
+    d.update(overrides)
+    return RunConfig.from_dict(d)
+
+
+def _artifact_bytes(root) -> dict[str, bytes]:
+    out = {}
+    for rel in (COUNTS_CSV, MERGED_FASTA):
+        path = root / "fastq_pass" / rel
+        assert path.exists(), f"missing artifact {rel}"
+        out[rel] = path.read_bytes()
+    return out
+
+
+def _report(root) -> dict:
+    return json.load(open(root / "fastq_pass" / REPORT))
+
+
+def _assert_byte_identical(chaos_lib, root):
+    got = _artifact_bytes(root)
+    for rel, want in chaos_lib["baseline_artifacts"].items():
+        assert got[rel] == want, f"{rel} diverged from the uninterrupted run"
+
+
+# --- in-run recovery scenarios ---------------------------------------------
+
+
+def test_chaos_transient_assign_dispatch_recovers(chaos_lib, tmp_path):
+    """A transient device fault on the fused-pass dispatch retries the
+    (idempotent) pass and completes with byte-identical outputs."""
+    root = tmp_path / "transient"
+    _stage_inputs(chaos_lib["inputs"], root)
+    results = run_with_config(_cfg(root, chaos=[
+        {"site": "assign.dispatch", "kind": "transient"},
+    ]))
+    assert results["barcode01"] == chaos_lib["baseline_counts"]
+    assert faults.fired("assign.dispatch") == 1
+    _assert_byte_identical(chaos_lib, root)
+    site = _report(root)["sites"]["assign.round1"]
+    assert site["by_outcome"]["retried"] == 1
+    assert site["by_outcome"]["recovered"] == 1
+    assert site["by_classification"]["transient"] >= 1
+    # resume after an in-run recovery is a no-op with identical results
+    resumed = run_with_config(_cfg(root, resume=True))
+    assert resumed["barcode01"] == chaos_lib["baseline_counts"]
+    _assert_byte_identical(chaos_lib, root)
+
+
+def test_chaos_oom_polish_shrinks_batch_and_completes(chaos_lib, tmp_path):
+    """RESOURCE_EXHAUSTED on the polish dispatch DEGRADES instead of
+    skipping: the chunk requeues at a budget-shrunken cluster batch and the
+    group completes — the library never enters the failed/skip path."""
+    root = tmp_path / "oom"
+    _stage_inputs(chaos_lib["inputs"], root)
+    results = run_with_config(_cfg(root, chaos=[
+        {"site": "polish.dispatch", "kind": "oom"},
+    ]))
+    assert results["barcode01"] == chaos_lib["baseline_counts"]
+    assert faults.fired("polish.dispatch") == 1
+    _assert_byte_identical(chaos_lib, root)
+    report = _report(root)
+    outcomes = report["sites"]["polish.dispatch"]["by_outcome"]
+    assert outcomes["oom_shrink"] == 1
+    assert outcomes["recovered"] >= 1
+    shrink = next(e for e in report["events"] if e["outcome"] == "oom_shrink")
+    assert shrink["classification"] == "oom"
+    assert (shrink["detail"]["cluster_batch_to"]
+            < shrink["detail"]["cluster_batch_from"])
+    # no group was skipped: the degradation log must not exist
+    assert not (root / "fastq_pass" / "nano_tcr" / "barcode01" / "logs"
+                / "incomplete_region_clusters.log").exists()
+
+
+def test_chaos_transient_polish_dispatch_retries_same_shape(chaos_lib, tmp_path):
+    """A transient fault on the polish dispatch retries the SAME chunk
+    shape (no batch shrink) and completes byte-identically."""
+    root = tmp_path / "polish_transient"
+    _stage_inputs(chaos_lib["inputs"], root)
+    results = run_with_config(_cfg(root, chaos=[
+        {"site": "polish.dispatch", "kind": "transient"},
+    ]))
+    assert results["barcode01"] == chaos_lib["baseline_counts"]
+    _assert_byte_identical(chaos_lib, root)
+    outcomes = _report(root)["sites"]["polish.dispatch"]["by_outcome"]
+    assert outcomes["retried"] == 1 and outcomes["recovered"] == 1
+    assert "oom_shrink" not in outcomes
+
+
+def test_chaos_overlap_worker_death_recomputed(chaos_lib, tmp_path):
+    """A QC worker thread dying of a transient fault is recomputed on the
+    main thread at commit; the run completes with identical outputs and
+    the error-profile artifact still exists."""
+    root = tmp_path / "worker"
+    _stage_inputs(chaos_lib["inputs"], root)
+    results = run_with_config(_cfg(root, chaos=[
+        {"site": "overlap.worker", "kind": "transient"},
+    ]))
+    assert results["barcode01"] == chaos_lib["baseline_counts"]
+    assert faults.fired("overlap.worker") == 1
+    _assert_byte_identical(chaos_lib, root)
+    logs = root / "fastq_pass" / "nano_tcr" / "barcode01" / "logs"
+    assert (logs / "barcode01_align_error_profile.log").exists()
+    outcomes = _report(root)["sites"]["overlap.worker"]["by_outcome"]
+    assert outcomes["retried"] == 1 and outcomes["recovered"] == 1
+
+
+@pytest.mark.parametrize("round_site,expect_fasta_identical", [
+    ("cluster.batched_round1", True),
+    ("cluster.batched_round2", True),
+])
+def test_chaos_poisoned_batched_pass_falls_back_per_region(
+        chaos_lib, tmp_path, round_site, expect_fasta_identical):
+    """A deterministic failure of the library-wide batched UMI clustering
+    pass degrades to the per-region retry loop with identical counts
+    (the run.py fallback that previously had zero test coverage)."""
+    root = tmp_path / round_site.replace(".", "_")
+    _stage_inputs(chaos_lib["inputs"], root)
+    results = run_with_config(_cfg(root, chaos=[
+        {"site": round_site, "kind": "error"},
+    ]))
+    assert results["barcode01"] == chaos_lib["baseline_counts"]
+    assert faults.fired(round_site) == 1
+    got = _artifact_bytes(root)
+    assert got[COUNTS_CSV] == chaos_lib["baseline_artifacts"][COUNTS_CSV]
+    if expect_fasta_identical:
+        assert got[MERGED_FASTA] == chaos_lib["baseline_artifacts"][MERGED_FASTA]
+    site = _report(root)["sites"][round_site]
+    assert site["by_outcome"]["degraded"] == 1
+    assert site["by_classification"]["fatal"] >= 1  # never burned retries
+    # the degraded run is COMPLETE: manifest marked, resume skips it
+    manifest = json.load(open(root / "fastq_pass" / MANIFEST))
+    assert "counts" in manifest
+
+
+# --- crash/resume scenarios -------------------------------------------------
+
+
+def test_chaos_torn_manifest_resume_regenerates(chaos_lib, tmp_path):
+    """A manifest torn mid-write (skip=1 tears the final 'counts' mark)
+    must not brick resume: the corrupt manifest reads as 'no stages done',
+    the library reruns, and the regenerated artifacts are byte-identical."""
+    root = tmp_path / "torn"
+    _stage_inputs(chaos_lib["inputs"], root)
+    results = run_with_config(_cfg(root, chaos=[
+        {"site": "layout.manifest_write", "kind": "torn", "skip": 1},
+    ]))
+    assert results["barcode01"] == chaos_lib["baseline_counts"]
+    assert faults.fired("layout.manifest_write") == 1
+    manifest_path = root / "fastq_pass" / MANIFEST
+    with pytest.raises(ValueError):
+        json.loads(manifest_path.read_text())  # really torn
+    # resume on the torn manifest: warns, reruns, byte-identical
+    resumed = run_with_config(_cfg(root, resume=True))
+    assert resumed["barcode01"] == chaos_lib["baseline_counts"]
+    _assert_byte_identical(chaos_lib, root)
+    manifest = json.loads(manifest_path.read_text())  # rewritten healthy
+    assert "counts" in manifest
+
+
+def test_chaos_preemption_drains_and_resumes(chaos_lib, tmp_path):
+    """A preemption request landing at the round-1 checkpoint stops the
+    run with the round-1 stage committed; resume completes round 2 only,
+    byte-identically."""
+    root = tmp_path / "preempt"
+    _stage_inputs(chaos_lib["inputs"], root)
+    with pytest.raises(shutdown.Preempted):
+        run_with_config(_cfg(root, chaos=[
+            {"site": "run.round1_checkpoint", "kind": "preempt"},
+        ]))
+    manifest = json.load(open(root / "fastq_pass" / MANIFEST))
+    assert "round1_consensus" in manifest  # committed checkpoint survives
+    assert "counts" not in manifest        # in-flight stage was NOT marked
+    # the report is written even on the preemption path
+    assert (root / "fastq_pass" / REPORT).exists()
+    # round-1 QC committed BEFORE the checkpoint: artifact present
+    logs = root / "fastq_pass" / "nano_tcr" / "barcode01" / "logs"
+    assert (logs / "barcode01_align_error_profile.log").exists()
+    resumed = run_with_config(_cfg(root, resume=True))
+    assert resumed["barcode01"] == chaos_lib["baseline_counts"]
+    _assert_byte_identical(chaos_lib, root)
+
+
+@pytest.mark.slow
+def test_chaos_process_kill_midstage_resume_byte_identical(chaos_lib, tmp_path):
+    """SIGKILL-grade process death (os._exit, no flushes) right after the
+    round-1 checkpoint: the manifest survives atomically, and a resume=true
+    rerun completes round 2 with byte-identical artifacts. Runs the
+    faulted half in a subprocess; slow-marked for the interpreter+compile
+    startup (`pytest -m chaos` includes it)."""
+    root = tmp_path / "kill"
+    _stage_inputs(chaos_lib["inputs"], root)
+    cfg = _cfg(root, chaos=[{"site": "run.round1_checkpoint", "kind": "kill"}])
+    cfg_path = tmp_path / "kill_config.json"
+    cfg_path.write_text(json.dumps(cfg.to_dict()))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop(faults.ENV_VAR, None)
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; from ont_tcrconsensus_tpu.pipeline.cli import main; "
+         "sys.exit(main(sys.argv[1:]))", str(cfg_path), "--cpu"],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == faults.KILL_EXIT_CODE, proc.stderr[-2000:]
+    assert "CHAOS: killing process" in proc.stderr
+    manifest = json.load(open(root / "fastq_pass" / MANIFEST))
+    assert "round1_consensus" in manifest and "counts" not in manifest
+    resumed = run_with_config(_cfg(root, resume=True))
+    assert resumed["barcode01"] == chaos_lib["baseline_counts"]
+    _assert_byte_identical(chaos_lib, root)
+
+
+def test_chaos_disarmed_run_writes_clean_report(chaos_lib):
+    """The A/B guard: with nothing armed the baseline run's report exists
+    and records zero events — the robustness layer is pure bookkeeping on
+    the no-fault path."""
+    report = _report(chaos_lib["tmp"] / "baseline")
+    assert report["sites"] == {}
+    assert report["events"] == []
+    assert report["policy"]["max_attempts"] >= 1
+    # SIGTERM disposition was restored: the run's coordinator is gone
+    handler = signal.getsignal(signal.SIGTERM)
+    owner = getattr(handler, "__self__", None)
+    assert not isinstance(owner, shutdown.ShutdownCoordinator)

@@ -85,6 +85,36 @@ def test_layout_resume(tmp_path):
     assert not lay2.stage_done("umi_extract")
 
 
+def test_layout_manifest_corruption_tolerated(tmp_path, capsys):
+    """A torn/invalid stage manifest must read as 'no stages done' (with a
+    warning) instead of crashing resume with a JSONDecodeError — the
+    preemption-mid-write case (ISSUE 2 satellite)."""
+    lay = layout.init_library_dir("/x/barcode01.fastq.gz", tmp_path)
+    lay.mark_stage_done("round1_consensus")
+    assert lay.stage_done("round1_consensus")
+    healthy = open(lay.manifest_path).read()
+
+    # torn write: a strict prefix of valid JSON
+    with open(lay.manifest_path, "w") as fh:
+        fh.write(healthy[: len(healthy) // 2])
+    assert lay.completed_stages() == {}
+    assert not lay.stage_done("round1_consensus")
+    assert "torn/corrupt" in capsys.readouterr().err
+
+    # marking after corruption rewrites a fresh, valid manifest
+    lay.mark_stage_done("counts")
+    assert set(lay.completed_stages()) == {"counts"}
+
+    # valid JSON of the wrong shape is tolerated the same way
+    with open(lay.manifest_path, "w") as fh:
+        fh.write("[1, 2, 3]")
+    assert lay.completed_stages() == {}
+
+    # empty file (fsync-less crash truncation) too
+    open(lay.manifest_path, "w").close()
+    assert lay.completed_stages() == {}
+
+
 def test_config_defaults_and_validation(tmp_path):
     cfg = RunConfig.from_dict({"reference_file": "ref.fa", "fastq_pass_dir": "fq"})
     assert cfg.cluster_identity == pytest.approx(0.93)

@@ -40,6 +40,40 @@ def test_commit_reraises_worker_failure_on_main_thread():
         ex.commit(stage)
 
 
+def test_commit_records_bg_timer_even_when_stage_failed():
+    """The worker's wall clock must land in <name>_bg on the FAILURE path
+    too — the timing table would otherwise under-report exactly the runs
+    someone is diagnosing (ISSUE 2 satellite)."""
+    ex = StageExecutor()
+    timer = StageTimer()
+
+    def boom():
+        time.sleep(0.05)
+        raise ValueError("qc exploded")
+
+    stage = ex.submit("bad_stage", boom)
+    with pytest.raises(ValueError, match="qc exploded"):
+        ex.commit(stage, timer)
+    assert timer.seconds["bad_stage_bg"] >= 0.05
+    assert "bad_stage" in timer.seconds  # critical-path wait still recorded
+
+
+def test_rerun_sync_reexecutes_the_stage_callable():
+    """rerun_sync is the transient-recovery path: the same callable runs
+    again on the calling thread and returns a fresh result."""
+    ex = StageExecutor()
+    calls = []
+
+    def work(x):
+        calls.append(x)
+        return x * 2
+
+    stage = ex.submit("s", work, 21)
+    assert ex.commit(stage) == 42
+    assert stage.rerun_sync() == 42
+    assert calls == [21, 21]
+
+
 def test_wait_all_collects_failures_without_raising():
     ex = StageExecutor()
     ex.submit("ok", lambda: 1)

@@ -1,0 +1,317 @@
+"""Robustness layer units (robustness/): classification, retry policy,
+fault registry, recorder, preemption coordinator."""
+
+import json
+import os
+import signal
+import time
+
+import pytest
+
+from ont_tcrconsensus_tpu.robustness import faults, retry, shutdown
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    yield
+    faults.disarm()
+    shutdown.deactivate()
+
+
+# --- classification ---------------------------------------------------------
+
+
+def test_classify_families():
+    assert retry.classify(faults.TransientChaosError("x")) == "transient"
+    assert retry.classify(faults.OomChaosError("x")) == "oom"
+    assert retry.classify(RuntimeError("UNAVAILABLE: socket closed")) == "transient"
+    assert retry.classify(RuntimeError("DEADLINE_EXCEEDED waiting")) == "transient"
+    assert retry.classify(ConnectionResetError("peer")) == "transient"
+    assert retry.classify(RuntimeError("RESOURCE_EXHAUSTED: alloc")) == "oom"
+    assert retry.classify(RuntimeError("Allocator ran out of memory")) == "oom"
+    assert retry.classify(MemoryError()) == "oom"
+    # a deterministic bug must never be retried
+    assert retry.classify(ValueError("shape mismatch")) == "fatal"
+    assert retry.classify(KeyError("region_cluster0")) == "fatal"
+
+
+def test_oom_markers_win_over_transient_markers():
+    # real XLA OOM messages often also mention the transfer machinery
+    exc = RuntimeError("RESOURCE_EXHAUSTED during transfer to device")
+    assert retry.classify(exc) == "oom"
+
+
+# --- retry policy -----------------------------------------------------------
+
+
+def test_retry_policy_deterministic_bounded():
+    p = retry.RetryPolicy(base_delay_s=0.1, max_delay_s=1.0, jitter=0.25, seed=7)
+    delays = [p.delay(a) for a in range(1, 9)]
+    assert delays == [p.delay(a) for a in range(1, 9)]  # pure in (seed, attempt)
+    assert all(d <= 1.0 * 1.25 for d in delays)  # capped (plus jitter band)
+    assert delays[0] < delays[4]  # grows before the cap
+
+
+def test_call_with_retry_recovers_from_transient():
+    rec = retry.RobustnessRecorder()
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise faults.TransientChaosError("flaky dispatch")
+        return "ok"
+
+    out = retry.call_with_retry(
+        "site", flaky, policy=retry.RetryPolicy(max_attempts=3, base_delay_s=0),
+        recorder=rec, sleep=lambda s: None,
+    )
+    assert out == "ok" and len(calls) == 2
+    assert [e["outcome"] for e in rec.events] == ["retried", "recovered"]
+    assert rec.events[0]["classification"] == "transient"
+
+
+def test_call_with_retry_fatal_raises_immediately():
+    rec = retry.RobustnessRecorder()
+    calls = []
+
+    def bug():
+        calls.append(1)
+        raise ValueError("deterministic bug")
+
+    with pytest.raises(ValueError):
+        retry.call_with_retry("site", bug, recorder=rec, sleep=lambda s: None)
+    assert len(calls) == 1  # never retried
+    assert rec.events[-1]["outcome"] == "fatal"
+
+
+def test_call_with_retry_oom_never_retries_same_shape():
+    """These call sites have no shrinkable batch: re-dispatching the same
+    shape into an exhausted HBM is doomed, so oom raises immediately to
+    the caller's degradation path instead of burning the retry budget."""
+    rec = retry.RobustnessRecorder()
+    calls = []
+
+    def ooms():
+        calls.append(1)
+        raise faults.OomChaosError("RESOURCE_EXHAUSTED: hbm full")
+
+    with pytest.raises(faults.OomChaosError):
+        retry.call_with_retry("site", ooms, recorder=rec, sleep=lambda s: None)
+    assert len(calls) == 1
+    assert rec.events[-1]["classification"] == "oom"
+    assert rec.events[-1]["outcome"] == "not_retryable"
+
+
+def test_call_with_retry_exhausts_and_reraises():
+    rec = retry.RobustnessRecorder()
+    calls = []
+
+    def always_flaky():
+        calls.append(1)
+        raise faults.TransientChaosError("still down")
+
+    with pytest.raises(faults.TransientChaosError):
+        retry.call_with_retry(
+            "site", always_flaky,
+            policy=retry.RetryPolicy(max_attempts=3, base_delay_s=0),
+            recorder=rec, sleep=lambda s: None,
+        )
+    assert len(calls) == 3
+    assert [e["outcome"] for e in rec.events] == ["retried", "retried", "exhausted"]
+
+
+def test_call_with_retry_reset_hook_clears_partial_side_effects():
+    rows = []
+    calls = []
+
+    def fn():
+        rows.append("partial")
+        calls.append(1)
+        if len(calls) < 2:
+            raise faults.TransientChaosError("mid-stream")
+        return list(rows)
+
+    out = retry.call_with_retry(
+        "site", fn, policy=retry.RetryPolicy(max_attempts=2, base_delay_s=0),
+        recorder=retry.RobustnessRecorder(), sleep=lambda s: None,
+        reset=rows.clear,
+    )
+    assert out == ["partial"]  # no duplicated partial rows
+
+
+# --- fault registry ---------------------------------------------------------
+
+
+def test_faults_skip_times_counters():
+    faults.arm([{"site": "polish.dispatch", "kind": "transient",
+                 "skip": 1, "times": 2}])
+    faults.inject("polish.dispatch")  # skip hit: passes through
+    with pytest.raises(faults.TransientChaosError):
+        faults.inject("polish.dispatch")
+    with pytest.raises(faults.TransientChaosError):
+        faults.inject("polish.dispatch")
+    faults.inject("polish.dispatch")  # times exhausted: disarmed
+    assert faults.fired("polish.dispatch") == 2
+    desc = faults.describe()
+    assert desc["hits"]["polish.dispatch"] == 4
+
+
+def test_faults_disarmed_is_noop():
+    faults.disarm()
+    faults.inject("polish.dispatch")
+    assert not faults.active()
+    assert faults.fired("polish.dispatch") == 0
+
+
+def test_faults_unknown_site_or_kind_rejected():
+    with pytest.raises(ValueError, match="unknown chaos site"):
+        faults.arm([{"site": "nope.nope"}])
+    with pytest.raises(ValueError, match="unknown chaos kind"):
+        faults.arm([{"site": "polish.dispatch", "kind": "wat"}])
+
+
+def test_faults_oom_and_error_kinds():
+    faults.arm([{"site": "polish.dispatch", "kind": "oom"},
+                {"site": "assign.dispatch", "kind": "error"}])
+    with pytest.raises(faults.OomChaosError, match="RESOURCE_EXHAUSTED"):
+        faults.inject("polish.dispatch")
+    with pytest.raises(RuntimeError, match="injected error fault"):
+        faults.inject("assign.dispatch")
+
+
+def test_faults_env_arming(monkeypatch):
+    faults.disarm()
+    monkeypatch.setenv(faults.ENV_VAR, json.dumps(
+        {"seed": 5, "faults": [{"site": "overlap.worker"}]}
+    ))
+    plan = faults.arm_from_env()
+    assert plan is not None and plan.seed == 5
+    with pytest.raises(faults.TransientChaosError):
+        faults.inject("overlap.worker")
+    # every run re-declares its chaos state: env arming is FRESH each time
+    # (counters reset), and an unset env leaves the current plan untouched
+    plan2 = faults.arm_from_env()
+    assert plan2 is not plan and faults.fired("overlap.worker") == 0
+    monkeypatch.delenv(faults.ENV_VAR)
+    assert faults.arm_from_env() is None
+    assert faults.active()  # unset env did not disarm plan2
+
+
+def test_faults_probabilistic_mode_is_seeded():
+    def pattern(seed):
+        faults.arm([{"site": "polish.dispatch", "p": 0.5, "times": 0}],
+                   seed=seed)
+        pat = []
+        for _ in range(32):
+            try:
+                faults.inject("polish.dispatch")
+                pat.append(0)
+            except faults.TransientChaosError:
+                pat.append(1)
+        return pat
+
+    assert pattern(3) == pattern(3)  # deterministic replay
+    assert 0 < sum(pattern(3)) < 32  # actually probabilistic
+    assert pattern(3) != pattern(4)  # seed-sensitive
+
+
+def test_tear_write_truncates_and_disarms(tmp_path):
+    path = str(tmp_path / "manifest.json")
+    payload = json.dumps({"round1_consensus": 123.0, "counts": 456.0})
+    faults.arm([{"site": "layout.manifest_write", "kind": "torn"}])
+    assert faults.tear_write("layout.manifest_write", path, payload) is True
+    torn = open(path).read()
+    assert torn and payload.startswith(torn) and len(torn) < len(payload)
+    with pytest.raises(ValueError):
+        json.loads(torn)
+    # spec exhausted: the next write goes through normally
+    assert faults.tear_write("layout.manifest_write", path, payload) is False
+
+
+# --- recorder ---------------------------------------------------------------
+
+
+def test_recorder_summary_and_report_write(tmp_path):
+    rec = retry.RobustnessRecorder()
+    rec.record("a", classification="transient", outcome="retried", attempt=1)
+    rec.record("a", classification="transient", outcome="recovered", attempt=2)
+    rec.record("b", classification="oom", outcome="oom_shrink",
+               detail={"cluster_batch_from": 8, "cluster_batch_to": 4})
+    s = rec.summary()
+    assert s["a"]["events"] == 2
+    assert s["a"]["by_outcome"] == {"retried": 1, "recovered": 1}
+    assert s["b"]["by_classification"] == {"oom": 1}
+    path = str(tmp_path / "robustness_report.json")
+    rec.write(path, policy=retry.RetryPolicy(max_attempts=5))
+    report = json.load(open(path))
+    assert report["policy"]["max_attempts"] == 5
+    assert report["sites"]["b"]["by_outcome"]["oom_shrink"] == 1
+    assert len(report["events"]) == 3
+
+
+# --- preemption coordinator -------------------------------------------------
+
+
+def test_shutdown_checkpoint_raises_after_request():
+    coord = shutdown.ShutdownCoordinator()
+    with coord:
+        shutdown.checkpoint("run.library_start")  # no-op before request
+        shutdown.request("test stop")
+        with pytest.raises(shutdown.Preempted) as ei:
+            shutdown.checkpoint("run.library_start")
+        assert ei.value.site == "run.library_start"
+    shutdown.checkpoint("run.library_start")  # deactivated: no-op again
+
+
+def test_shutdown_preempted_is_not_an_exception():
+    # the per-library `except Exception` degradation guard must never
+    # swallow a preemption into "library failed, skipped"
+    assert not issubclass(shutdown.Preempted, Exception)
+    assert issubclass(shutdown.Preempted, BaseException)
+
+
+def test_shutdown_real_signal_sets_flag_and_restores_handler():
+    coord = shutdown.ShutdownCoordinator()
+    prev = signal.getsignal(signal.SIGTERM)
+    with coord:
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.time() + 5.0
+        while not coord.requested() and time.time() < deadline:
+            time.sleep(0.01)  # delivery lands between bytecodes
+        assert coord.requested()
+        with pytest.raises(shutdown.Preempted):
+            shutdown.checkpoint("after_signal")
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_first_real_signal_after_cooperative_request_still_drains():
+    """A chaos preempt / request() must not make the NEXT real SIGTERM
+    look like a 'second signal': the first actual signal always takes the
+    drain path, keeping the handler installed."""
+    coord = shutdown.ShutdownCoordinator()
+    saved = {sig: signal.getsignal(sig) for sig in coord.SIGNALS}
+    try:
+        with coord:
+            # pre-neuter the saved dispositions so a regression to the old
+            # behavior (uninstall + re-kill) cannot take down the process
+            coord._previous = {sig: signal.SIG_IGN for sig in coord.SIGNALS}
+            shutdown.request("chaos preempt")
+            coord._on_signal(signal.SIGTERM, None)  # FIRST real signal
+            assert coord._installed  # drain path: no escalation
+            assert coord.requested()
+            coord._on_signal(signal.SIGTERM, None)  # second real signal
+            assert not coord._installed  # now the operator means NOW
+    finally:
+        for sig, handler in saved.items():  # undo the neutered restore
+            signal.signal(sig, handler)
+        shutdown.deactivate()
+
+
+def test_preempt_chaos_kind_triggers_active_coordinator():
+    coord = shutdown.ShutdownCoordinator()
+    with coord:
+        faults.arm([{"site": "run.round1_checkpoint", "kind": "preempt"}])
+        faults.inject("run.round1_checkpoint")  # requests, does not raise
+        with pytest.raises(shutdown.Preempted):
+            shutdown.checkpoint("run.round1_checkpoint")
