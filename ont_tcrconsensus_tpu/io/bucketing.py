@@ -24,6 +24,19 @@ DEFAULT_WIDTHS = (256, 512, 1024, 2048, 3072, 4096)
 
 
 @dataclasses.dataclass
+class IngestCounters:
+    """Accounting for the ingest conservation contract
+    (robustness/contracts.py): every record drawn from the parser is either
+    emitted into a batch or counted into a drop bucket, so
+    ``n_records - n_dropped_short - n_dropped_long`` must equal the number
+    of valid batch rows the device pass sees."""
+
+    n_records: int = 0       # records drawn from the parser (post-subsample)
+    n_dropped_short: int = 0  # below the batcher's min_len gate
+    n_dropped_long: int = 0   # above the largest configured width
+
+
+@dataclasses.dataclass
 class ReadBatch:
     """One padded device-ready batch.
 
@@ -71,12 +84,14 @@ def batch_reads(
     widths: Sequence[int] = DEFAULT_WIDTHS,
     with_quals: bool = True,
     min_len: int = 1,
+    counters: IngestCounters | None = None,
 ) -> Iterator[ReadBatch]:
     """Group FastxRecords into per-width padded batches.
 
     Reads longer than the largest width (or shorter than ``min_len``) are
     dropped — mirroring the pipeline's hard length gates
-    (/root/reference/configs/run_config.json: minimal_length).
+    (/root/reference/configs/run_config.json: minimal_length) — and tallied
+    into ``counters`` when given (the ingest conservation contract).
     Emission order within a bucket preserves input order; buckets flush when
     full and at end-of-stream.
     """
@@ -89,10 +104,16 @@ def batch_reads(
 
     for rec in records:
         ln = len(rec.sequence)
+        if counters is not None:
+            counters.n_records += 1
         if ln < min_len:
+            if counters is not None:
+                counters.n_dropped_short += 1
             continue
         w = bucket_width(ln, widths)
         if w is None:
+            if counters is not None:
+                counters.n_dropped_long += 1
             continue
         pending[w].append(rec)
         if len(pending[w]) == batch_size:
@@ -197,6 +218,7 @@ def batch_parsed_chunks(
     widths: Sequence[int] = DEFAULT_WIDTHS,
     min_len: int = 1,
     subsample: int | None = None,
+    counters: IngestCounters | None = None,
 ) -> Iterator[ReadBatch]:
     """:func:`batch_parsed_reads` over a STREAM of ParsedFastx chunks.
 
@@ -230,6 +252,13 @@ def batch_parsed_chunks(
             taken += n_raw
         lens = np.asarray(parsed.lengths)[:n_raw]
         bucket_idx = np.searchsorted(widths_arr, lens)
+        if counters is not None:  # vectorized drop accounting (contracts)
+            counters.n_records += int(n_raw)
+            short = lens < min_len
+            counters.n_dropped_short += int(short.sum())
+            counters.n_dropped_long += int(
+                (~short & (bucket_idx >= len(widths_arr))).sum()
+            )
         eligible = np.where((lens >= min_len) & (bucket_idx < len(widths_arr)))[0]
         for r in eligible:
             w = int(widths_arr[bucket_idx[r]])

@@ -45,50 +45,80 @@ def _split_header(line: str) -> tuple[str, str]:
     return parts[0], parts[1] if len(parts) > 1 else ""
 
 
+def _gzip_context(path, fh, exc) -> ValueError:
+    """Wrap a gzip decode failure with file + byte-offset context.
+
+    ``gzip.BadGzipFile``/``EOFError`` out of a streaming read used to
+    surface as a raw traceback with no hint of WHICH file died WHERE; the
+    quarantine path (io/validate.py) turns these into events, but even
+    under ``on_bad_record=fail`` the error must name the file and the
+    decompressed offset reached.
+    """
+    try:
+        offset = fh.buffer.tell() if hasattr(fh, "buffer") else fh.tell()
+    except (OSError, ValueError):
+        offset = -1
+    return ValueError(
+        f"{os.fspath(path)}: truncated or corrupt gzip stream near "
+        f"decompressed byte offset {offset} ({exc}); with "
+        "on_bad_record=quarantine the decodable prefix is kept and this "
+        "becomes a quarantine event"
+    )
+
+
 def read_fastx(path: str | os.PathLike[str]) -> Iterator[FastxRecord]:
     """Iterate records from a FASTA/FASTQ file (.gz transparent).
 
     Format is sniffed from the first record character. FASTA sequences may be
     multi-line; FASTQ records must be 4-line (the only form ONT emits).
+    A truncated/corrupt ``.gz`` raises ValueError with file + offset context
+    instead of a bare gzip traceback.
     """
     with _open_text(path) as fh:
-        first = fh.read(1)
-        if not first:
-            return
-        if first == ">":
-            name, comment = _split_header(">" + fh.readline())
-            seq_parts: list[str] = []
-            for line in fh:
-                if line.startswith(">"):
-                    yield FastxRecord(name, comment, "".join(seq_parts))
-                    name, comment = _split_header(line)
-                    seq_parts = []
-                else:
-                    seq_parts.append(line.strip())
-            yield FastxRecord(name, comment, "".join(seq_parts))
-        elif first == "@":
-            header = "@" + fh.readline()
-            while header:
-                if not header.strip():  # tolerate blank lines between records
-                    header = fh.readline()
-                    continue
-                name, comment = _split_header(header)
-                seq = fh.readline().strip()
-                plus = fh.readline()
-                qual = fh.readline().strip()
-                if not plus.startswith("+"):
-                    raise ValueError(f"malformed FASTQ record near {name!r} in {path}")
-                if not qual and seq:
-                    raise ValueError(f"truncated FASTQ record {name!r} in {path}")
-                if len(qual) != len(seq):
-                    raise ValueError(
-                        f"FASTQ record {name!r} in {path}: qual length "
-                        f"{len(qual)} != seq length {len(seq)}"
-                    )
-                yield FastxRecord(name, comment, seq, qual)
+        try:
+            yield from _read_fastx_body(path, fh)
+        except (gzip.BadGzipFile, EOFError) as exc:
+            raise _gzip_context(path, fh, exc) from exc
+
+
+def _read_fastx_body(path, fh) -> Iterator[FastxRecord]:
+    first = fh.read(1)
+    if not first:
+        return
+    if first == ">":
+        name, comment = _split_header(">" + fh.readline())
+        seq_parts: list[str] = []
+        for line in fh:
+            if line.startswith(">"):
+                yield FastxRecord(name, comment, "".join(seq_parts))
+                name, comment = _split_header(line)
+                seq_parts = []
+            else:
+                seq_parts.append(line.strip())
+        yield FastxRecord(name, comment, "".join(seq_parts))
+    elif first == "@":
+        header = "@" + fh.readline()
+        while header:
+            if not header.strip():  # tolerate blank lines between records
                 header = fh.readline()
-        else:
-            raise ValueError(f"{path}: not FASTA/FASTQ (starts with {first!r})")
+                continue
+            name, comment = _split_header(header)
+            seq = fh.readline().strip()
+            plus = fh.readline()
+            qual = fh.readline().strip()
+            if not plus.startswith("+"):
+                raise ValueError(f"malformed FASTQ record near {name!r} in {path}")
+            if not qual and seq:
+                raise ValueError(f"truncated FASTQ record {name!r} in {path}")
+            if len(qual) != len(seq):
+                raise ValueError(
+                    f"FASTQ record {name!r} in {path}: qual length "
+                    f"{len(qual)} != seq length {len(seq)}"
+                )
+            yield FastxRecord(name, comment, seq, qual)
+            header = fh.readline()
+    else:
+        raise ValueError(f"{path}: not FASTA/FASTQ (starts with {first!r})")
 
 
 def read_fasta_dict(path: str | os.PathLike[str]) -> dict[str, str]:
@@ -150,9 +180,12 @@ def count_fasta_records(path: str | os.PathLike[str]) -> int:
     (/root/reference/ont_tcr_consensus/count.py:9-20)."""
     n = 0
     with _open_text(path) as fh:
-        for line in fh:
-            if line.startswith(">"):
-                n += 1
+        try:
+            for line in fh:
+                if line.startswith(">"):
+                    n += 1
+        except (gzip.BadGzipFile, EOFError) as exc:
+            raise _gzip_context(path, fh, exc) from exc
     return n
 
 

@@ -77,6 +77,13 @@ class LibraryLayout:
         return os.path.join(self.library_dir, "counts")
 
     @property
+    def quarantine_path(self) -> str:
+        """Per-library quarantine artifact (on_bad_record=quarantine): the
+        raw bytes of every malformed input region, gzip-compressed, with
+        machine-readable reasons in robustness_report.json."""
+        return os.path.join(self.library_dir, "quarantine.fastq.gz")
+
+    @property
     def manifest_path(self) -> str:
         return os.path.join(self.library_dir, "stage_manifest.json")
 

@@ -64,6 +64,24 @@ def load() -> ctypes.CDLL | None:
         ]
         lib.fastx_free.restype = None
         lib.fastx_free.argtypes = [ctypes.c_void_p]
+        # tolerant (quarantine-mode) API: bad-record accessors + the
+        # tolerant open/parse variants (PR 3 data-plane hardening)
+        lib.fastx_parse2.restype = ctypes.c_void_p
+        lib.fastx_parse2.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.fastx_num_bad.restype = ctypes.c_int64
+        lib.fastx_num_bad.argtypes = [ctypes.c_void_p]
+        lib.fastx_bad_offset.restype = ctypes.c_int64
+        lib.fastx_bad_offset.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.fastx_bad_reason.restype = ctypes.c_char_p
+        lib.fastx_bad_reason.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.fastx_bad_raw_size.restype = ctypes.c_int64
+        lib.fastx_bad_raw_size.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.fastx_bad_raw_copy.restype = None
+        lib.fastx_bad_raw_copy.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p,
+        ]
+        lib.fastx_open2.restype = ctypes.c_void_p
+        lib.fastx_open2.argtypes = [ctypes.c_char_p, ctypes.c_int]
         lib.fastx_open.restype = ctypes.c_void_p
         lib.fastx_open.argtypes = [ctypes.c_char_p]
         lib.fastx_stream_error.restype = ctypes.c_char_p
@@ -85,6 +103,9 @@ class ParsedFastx:
     lengths: np.ndarray   # (N,) int32
     offsets: np.ndarray   # (N+1,) int64 into codes/quals
     names: list[str]      # full headers
+    # tolerant mode: (absolute byte offset, canonical reason, raw bytes)
+    # per quarantined region; always [] under the strict (default) parse
+    bad: list[tuple[int, str, bytes]] = dataclasses.field(default_factory=list)
 
     @property
     def num_records(self) -> int:
@@ -122,39 +143,58 @@ def _copy_out(lib, handle, path) -> ParsedFastx:
             names_buf,
         )
         names = names_buf.raw.decode("utf-8", "replace").split("\n")[:n]
+        bad: list[tuple[int, str, bytes]] = []
+        for i in range(int(lib.fastx_num_bad(handle))):
+            size = int(lib.fastx_bad_raw_size(handle, i))
+            raw_buf = ctypes.create_string_buffer(size) if size else None
+            if raw_buf is not None:
+                lib.fastx_bad_raw_copy(handle, i, raw_buf)
+            bad.append((
+                int(lib.fastx_bad_offset(handle, i)),
+                lib.fastx_bad_reason(handle, i).decode("utf-8", "replace"),
+                raw_buf.raw if raw_buf is not None else b"",
+            ))
         return ParsedFastx(codes=codes, quals=quals, lengths=lengths,
-                           offsets=offsets, names=names)
+                           offsets=offsets, names=names, bad=bad)
     finally:
         lib.fastx_free(handle)
 
 
-def parse_file(path: str | os.PathLike[str]) -> ParsedFastx | None:
+def parse_file(
+    path: str | os.PathLike[str], tolerant: bool = False,
+) -> ParsedFastx | None:
     """Parse with the native library; None when the library is unavailable.
 
-    Raises ValueError on malformed input (same contract as fastx.read_fastx).
+    Strict (default): raises ValueError on malformed input (same contract as
+    fastx.read_fastx). ``tolerant=True``: malformed records/regions land in
+    ``ParsedFastx.bad`` (offset, canonical reason, raw bytes) and parsing
+    resynchronizes at the next record — the quarantine-policy ingest path.
     Materializes the WHOLE file — fine for references and tests; lane-scale
     read files go through :func:`parse_chunks` (SURVEY §7 hard-part 5).
     """
     lib = load()
     if lib is None:
         return None
-    handle = lib.fastx_parse(os.fspath(path).encode())
+    handle = lib.fastx_parse2(os.fspath(path).encode(), 1 if tolerant else 0)
     return _copy_out(lib, handle, path)
 
 
 def parse_chunks(
     path: str | os.PathLike[str], chunk_bases: int = 32 << 20,
+    tolerant: bool = False,
 ):
     """Generator of ParsedFastx chunks with O(chunk) host memory.
 
     Yields nothing (and returns) when the native library is unavailable —
     callers must check :func:`available` first or fall back themselves.
-    Raises ValueError on malformed input, like :func:`parse_file`.
+    Raises ValueError on malformed input, like :func:`parse_file`; with
+    ``tolerant=True`` malformed regions ride along in each chunk's ``bad``
+    list instead (a chunk may carry bad entries and zero records).
     """
     lib = load()
     if lib is None:
         return
-    stream = lib.fastx_open(os.fspath(path).encode())
+    stream = lib.fastx_open2(os.fspath(path).encode(), 1 if tolerant else 0)
     try:
         err = lib.fastx_stream_error(stream)
         if err:

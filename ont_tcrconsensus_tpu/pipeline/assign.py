@@ -596,10 +596,15 @@ class AlignStats:
     n_ee_fail: int = 0
     n_trimmed: int = 0     # reads with at least one primer cut
     n_aligned: int = 0     # score >= MIN_SCORE among EE survivors
+    n_unaligned: int = 0   # EE survivors below the score gate
     n_short: int = 0
     n_long: int = 0
     n_low_blast: int = 0
     n_pass: int = 0
+    # ingest accounting (conservation contracts, robustness/contracts.py)
+    n_ingested: int = 0        # records drawn from the parser
+    n_bucket_short: int = 0    # dropped below the batcher min_len gate
+    n_bucket_long: int = 0     # dropped above the largest width bucket
     pre_filter: LengthStats = dataclasses.field(default_factory=LengthStats)
     post_filter: LengthStats = dataclasses.field(default_factory=LengthStats)
 
@@ -843,7 +848,8 @@ def _prefetch(iterator, depth: int = 2):
         except BaseException as exc:  # propagate into the consumer
             put_until_stop(exc)
 
-    threading.Thread(target=worker, daemon=True).start()
+    thread = threading.Thread(target=worker, daemon=True)
+    thread.start()
     try:
         while True:
             item = q.get()
@@ -854,14 +860,30 @@ def _prefetch(iterator, depth: int = 2):
             yield item
     finally:
         stop.set()
+        # JOIN, not just signal: the worker may be mid-pull (parsing a
+        # chunk, routing bad records through an IngestGuard) — a retrying
+        # caller resets that guard right after this generator unwinds, so
+        # a stale worker touching it after teardown would double-count
+        # quarantined records. Bounded: the worker exits at its next
+        # put/stop check (<= 0.5 s) once the current pull completes.
+        thread.join()
 
 
-def _batches_from_source(source, batch_size, widths, subsample):
+def _batches_from_source(source, batch_size, widths, subsample,
+                         counters=None, guard=None):
     """Batch iterator from a file path (native C++ parser when available,
-    pure-Python fallback) or any FastxRecord iterable."""
+    pure-Python fallback) or any FastxRecord iterable.
+
+    ``guard`` (an :class:`..io.validate.IngestGuard`) switches a path
+    source to the TOLERANT parsers: malformed records/regions are routed to
+    the guard (quarantine/drop per its policy) instead of raising, and
+    parsing resynchronizes at the next record. Without a guard the legacy
+    fail-fast behavior is unchanged.
+    """
     if isinstance(source, (str, os.PathLike)):
         from ont_tcrconsensus_tpu.io import native
 
+        tolerant = guard is not None
         # STREAMED ingest: O(chunk) host memory, so a 100+ GB lane never
         # materializes (SURVEY §7 hard-part 5; VERDICT r3 #5). Batch shapes
         # are identical to a whole-file parse. The FIRST chunk is pulled
@@ -873,7 +895,7 @@ def _batches_from_source(source, batch_size, widths, subsample):
         first_cell: list = []
         try:
             if native.available():
-                chunk_iter = native.parse_chunks(source)
+                chunk_iter = native.parse_chunks(source, tolerant=tolerant)
                 first = next(chunk_iter, None)
                 if first is not None:
                     first_cell.append(first)
@@ -884,18 +906,29 @@ def _batches_from_source(source, batch_size, widths, subsample):
             chunk_iter = None
         if chunk_iter is not None:
             def chunks():
+                def consume_bad(parsed):
+                    if guard is not None and parsed.bad:
+                        guard.handle_native(parsed.bad)
+                    return parsed
+
                 while first_cell:
                     # pop so the eager first chunk frees after consumption
                     # instead of staying pinned for the whole ingest
-                    yield first_cell.pop()
-                yield from chunk_iter
+                    yield consume_bad(first_cell.pop())
+                for parsed in chunk_iter:
+                    yield consume_bad(parsed)
 
             return bucketing.batch_parsed_chunks(
                 chunks(),
                 batch_size=batch_size, widths=widths, min_len=1,
-                subsample=subsample,
+                subsample=subsample, counters=counters,
             )
-        source = fastx.read_fastx(source)
+        if tolerant:
+            from ont_tcrconsensus_tpu.io import validate as validate_mod
+
+            source = validate_mod.iter_records_tolerant(source, guard)
+        else:
+            source = fastx.read_fastx(source)
 
     records = iter(source)
 
@@ -908,7 +941,8 @@ def _batches_from_source(source, batch_size, widths, subsample):
             yield rec
 
     return bucketing.batch_reads(
-        limited(), batch_size=batch_size, widths=widths, min_len=1
+        limited(), batch_size=batch_size, widths=widths, min_len=1,
+        counters=counters,
     )
 
 
@@ -927,6 +961,7 @@ def run_assign(
     subsample: int | None = None,
     prefetch_depth: int = 2,
     dispatch=None,
+    guard=None,
 ) -> tuple[ReadStore, AlignStats]:
     """Stream a fastx file or record iterable through the fused pass.
 
@@ -942,9 +977,12 @@ def run_assign(
     A path source uses the native C++ parser when the extension builds
     (io/native), falling back to the pure-Python parser; batch building is
     prefetched on a worker thread so ingest overlaps device compute.
+    ``guard`` (io/validate.IngestGuard) routes malformed records to
+    quarantine/drop instead of failing the file (data-plane hardening).
     """
     panel = engine.panel
     stats = AlignStats()
+    counters = bucketing.IngestCounters()
     acc: dict[int, list[dict]] = defaultdict(list)
     acc_names: dict[int, list[list[str]]] = defaultdict(list)
 
@@ -972,6 +1010,7 @@ def run_assign(
         )
         aligned = ee_ok & (out["score"] >= MIN_SCORE)
         stats.n_aligned += int(aligned.sum())
+        stats.n_unaligned += int((ee_ok & ~aligned).sum())
 
         rlens = panel.lens[out["ridx"]]
         ref_span = out["ref_end"] - out["ref_start"]
@@ -1090,11 +1129,17 @@ def run_assign(
 
     consumer = threading.Thread(target=consumer_loop, daemon=True)
     consumer.start()
+    # held in a name so the finally can CLOSE it: an exception flying out
+    # of the loop leaves a for-statement generator open until GC, and its
+    # prefetch worker would keep feeding the guard while the retry wrapper
+    # is already resetting it
+    prefetch_gen = _prefetch(
+        _batches_from_source(source, batch_size, widths, subsample,
+                             counters=counters, guard=guard),
+        depth=prefetch_depth,
+    )
     try:
-        for batch in _prefetch(
-            _batches_from_source(source, batch_size, widths, subsample),
-            depth=prefetch_depth,
-        ):
+        for batch in prefetch_gen:
             if not acquire_permit():
                 break
             # chaos site: a transient device fault on the fused-pass
@@ -1116,6 +1161,7 @@ def run_assign(
                 )
             inflight.put((batch, out_dev))
     finally:
+        prefetch_gen.close()  # runs _prefetch's finally: stop + join worker
         inflight.put(_PREFETCH_DONE)
         consumer.join()
     if consumer_err:
@@ -1143,4 +1189,34 @@ def run_assign(
                    if all(p["quals"] is not None for p in parts) else None),
             sw_done=np.concatenate([p["sw_done"] for p in parts]),
         ))
-    return ReadStore(blocks=blocks), stats
+    stats.n_ingested = counters.n_records
+    stats.n_bucket_short = counters.n_dropped_short
+    stats.n_bucket_long = counters.n_dropped_long
+    store = ReadStore(blocks=blocks)
+    # stage-boundary conservation contracts (robustness/contracts.py):
+    # quarantined records never reach the batcher, so the parsed records
+    # minus the bucket drops must be exactly what the device pass counted,
+    # the filter categories must partition that total, and the columnar
+    # store must hold exactly the passing reads.
+    from ont_tcrconsensus_tpu.robustness import contracts
+
+    src_desc = str(source)[:200] if isinstance(source, (str, os.PathLike)) else "<records>"
+    contracts.check_equal(
+        "ingest", "records parsed minus bucket drops",
+        counters.n_records - counters.n_dropped_short - counters.n_dropped_long,
+        "reads entering the device pass", stats.n_total,
+        detail={"source": src_desc, "ingested": counters.n_records,
+                "bucket_short": counters.n_dropped_short,
+                "bucket_long": counters.n_dropped_long},
+    )
+    contracts.check_equal(
+        "assign_partition", "filter category sum",
+        stats.n_ee_fail + stats.n_unaligned + stats.n_short + stats.n_long
+        + stats.n_low_blast + stats.n_pass,
+        "batch total", stats.n_total, detail={"source": src_desc},
+    )
+    contracts.check_equal(
+        "assign_store", "columnar store rows", store.num_reads,
+        "passing reads", stats.n_pass, detail={"source": src_desc},
+    )
+    return store, stats

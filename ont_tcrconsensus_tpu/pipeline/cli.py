@@ -29,7 +29,20 @@ def main(argv: list[str] | None = None) -> int:
         "JAX_PLATFORMS, so when the device tunnel is wedged any jax init "
         "hangs; the config API is the only reliable override.",
     )
+    parser.add_argument(
+        "--validate", action="store_true",
+        help="Dry-run input validation: parse the config, scan every input "
+        "file (record counts/sizes via the tolerant parser — no device "
+        "work, no jax import), print a validation report, and exit "
+        "non-zero on any problem.",
+    )
     args = parser.parse_args(argv)
+
+    if args.validate:
+        # never touches jax: safe on hosts with a wedged device tunnel
+        from ont_tcrconsensus_tpu.io import validate as validate_mod
+
+        return validate_mod.validate_inputs(args.json_config_file)
 
     if args.cpu or os.environ.get("TCR_CONSENSUS_FORCE_CPU"):
         import jax

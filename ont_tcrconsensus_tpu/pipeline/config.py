@@ -172,6 +172,17 @@ class RunConfig:
     #   arms the same way when this key is null. None/[] = chaos off
     #   (injection points are a single global check)
     chaos_seed: int = 0  # seed for probabilistic ("p") chaos specs
+    on_bad_record: str = "fail"  # data-fault policy for malformed input
+    #   records (io/validate.py): "fail" keeps the legacy first-bad-record-
+    #   raises behavior; "quarantine" resynchronizes at the next record and
+    #   lands the bad bytes in a per-library quarantine.fastq.gz with
+    #   machine-readable reasons in robustness_report.json; "drop" counts +
+    #   reports without keeping the bytes. Truncated gzip and truncated
+    #   final records become quarantine events instead of tracebacks.
+    contracts: str = "warn"  # stage-boundary conservation contracts
+    #   (robustness/contracts.py): "off" skips the checks, "warn" (default)
+    #   logs + records violations in robustness_report.json, "strict"
+    #   additionally fails the run on the first violation
     polish_bf16: bool = True  # allow bf16 polisher serving WHEN the
     #   per-backend exactness A/B artifact certifies identical consensus
     #   output (models/polisher.py bf16_serving_certified; generate with
@@ -286,6 +297,15 @@ class RunConfig:
                 faults_mod.FaultSpec(**s)
         if self.polish_method not in ("poa", "rnn"):
             raise ValueError(f"polish_method={self.polish_method!r} not in ('poa', 'rnn')")
+        if self.on_bad_record not in ("fail", "quarantine", "drop"):
+            raise ValueError(
+                f"on_bad_record={self.on_bad_record!r} not in "
+                "('fail', 'quarantine', 'drop')"
+            )
+        if self.contracts not in ("off", "warn", "strict"):
+            raise ValueError(
+                f"contracts={self.contracts!r} not in ('off', 'warn', 'strict')"
+            )
         for pat_name in ("umi_fwd", "umi_rev"):
             pat = getattr(self, pat_name)
             if not pat or any(c not in "ACGTUNRYSWKMBDHV" for c in pat.upper()):

@@ -34,11 +34,12 @@ import numpy as np
 
 from ont_tcrconsensus_tpu.cluster import regions as regions_mod
 from ont_tcrconsensus_tpu.io import bucketing, fastx, layout
+from ont_tcrconsensus_tpu.io import validate as validate_mod
 from ont_tcrconsensus_tpu.pipeline import overlap, stages
 from ont_tcrconsensus_tpu.pipeline.config import RunConfig
 from ont_tcrconsensus_tpu.qc import artifacts, umi_overlap
 from ont_tcrconsensus_tpu.qc.timing import StageTimer
-from ont_tcrconsensus_tpu.robustness import faults, retry, shutdown
+from ont_tcrconsensus_tpu.robustness import contracts, faults, retry, shutdown
 
 # fallback precision bar when no reference pair survives the homology filter
 # (the reference would crash there; see cluster/regions.py docstring)
@@ -158,6 +159,9 @@ def _run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]
     ))
     recorder = retry.recorder()
     recorder.reset()
+    # stage-boundary conservation contracts: per-run mode + fresh counters
+    contracts.set_mode(cfg.contracts)
+    contracts.reset()
     if cfg.distributed:
         # no-op when already up (e.g. the CLI initialized pre-import);
         # required: a failed bring-up must abort, not degrade to N racing
@@ -329,7 +333,7 @@ def _run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]
                 nano_dir,
                 "robustness_report.json" if n_proc == 1
                 else f"robustness_report_p{proc_id}.json",
-            ), policy=policy)
+            ), policy=policy, contracts=contracts.summary())
         except OSError as exc:  # report trouble must never mask the run's fate
             _log(f"WARNING: could not write robustness report: {exc!r}")
     if failed_libraries:
@@ -449,25 +453,53 @@ def _run_library_impl(fastq, lay, cfg, panel, engine, engine_notrim,
     # (trim -> EE -> align -> UMI locate; preprocessing.py:7-159 +
     # minimap2_align.py:76-155 + region_split.py:219-333 + extract_umis.py)
     _log("Preprocessing, aligning and UMI-tagging nanopore reads:", library)
-    with timer.stage("round1_fused_assign"):
-        # transient-retry wrap: the fused pass is idempotent (it streams
-        # the fastq into a fresh store), so a dropped device connection
-        # mid-library re-runs the whole pass instead of skipping the
-        # library (robustness/retry.py classification)
-        store, astats = retry.call_with_retry(
-            "assign.round1",
-            lambda: stages.run_assign(
-                fastq, engine,
-                max_ee_rate=cfg.max_ee_rate_base,
-                min_len=cfg.minimal_length,
-                minimal_region_overlap=cfg.minimal_region_overlap,
-                max_softclip_5_end=cfg.max_softclip_5_end,
-                max_softclip_3_end=cfg.max_softclip_3_end,
-                batch_size=read_batch,
-                max_read_length=cfg.max_read_length,
-                subsample=cfg.dorado_trim_subsample_fastq,
-            ),
+    # chaos site for file-level data faults: corrupt-input / truncate-file
+    # swap in a seeded-mutated sibling copy of the input (the original is
+    # never touched); with on_bad_record=quarantine the damage must land in
+    # quarantine.fastq.gz while the clean subset flows through untouched
+    fastq = faults.mutate_input("ingest.library_fastq", fastq)
+    guard = None
+    if cfg.on_bad_record != "fail":
+        guard = validate_mod.IngestGuard(
+            cfg.on_bad_record, source=os.fspath(fastq),
+            quarantine_path=lay.quarantine_path,
         )
+    try:
+        with timer.stage("round1_fused_assign"):
+            # transient-retry wrap: the fused pass is idempotent (it
+            # streams the fastq into a fresh store), so a dropped device
+            # connection mid-library re-runs the whole pass instead of
+            # skipping the library (robustness/retry.py classification).
+            # The guard resets with it so a retry cannot double-count
+            # quarantined records.
+            store, astats = retry.call_with_retry(
+                "assign.round1",
+                lambda: stages.run_assign(
+                    fastq, engine,
+                    max_ee_rate=cfg.max_ee_rate_base,
+                    min_len=cfg.minimal_length,
+                    minimal_region_overlap=cfg.minimal_region_overlap,
+                    max_softclip_5_end=cfg.max_softclip_5_end,
+                    max_softclip_3_end=cfg.max_softclip_3_end,
+                    batch_size=read_batch,
+                    max_read_length=cfg.max_read_length,
+                    subsample=cfg.dorado_trim_subsample_fastq,
+                    guard=guard,
+                ),
+                reset=guard.reset if guard is not None else None,
+            )
+    finally:
+        # finalize even when the library fails: the quarantine gzip must
+        # gain its trailer (an open handle leaves a truncated artifact)
+        # and the ingest events must reach the robustness report — they
+        # are exactly the diagnostics a failed library needs
+        if guard is not None:
+            qsummary = guard.finalize(retry.recorder())
+            if qsummary["n_bad"]:
+                verb = ("quarantined" if guard.policy == "quarantine"
+                        else "dropped")
+                _log(f"ingest: {qsummary['n_bad']} bad record(s) in "
+                     f"{library} {verb} ({qsummary['by_reason']})")
     with open(os.path.join(lay.logs, "ee_filter.log"), "w") as fh:
         fh.write(
             f"reads passing EE/length filter: {astats.n_total - astats.n_ee_fail}\n"
@@ -617,12 +649,19 @@ def _run_library_impl(fastq, lay, cfg, panel, engine, engine_notrim,
             mesh=engine.mesh,
         )
     merged_consensus: list[tuple[str, str]] = []
-    for group_name, _ in selected_by_group:
+    for group_name, selected in selected_by_group:
         if group_name in polish_failed:
             failed_groups.append((group_name, polish_failed[group_name]))
             _log(f"WARNING: {group_name} polish failed and is skipped: "
                  f"{polish_failed[group_name]}")
         else:
+            # conservation: every selected cluster of a non-failed group
+            # must have produced exactly one consensus record
+            contracts.check_equal(
+                "consensus", f"{group_name} consensus records",
+                len(by_group[group_name]), "selected clusters", len(selected),
+                detail={"library": library, "group": group_name},
+            )
             merged_consensus.extend(by_group[group_name])
     if failed_groups:
         _log(
@@ -640,7 +679,12 @@ def _run_library_impl(fastq, lay, cfg, panel, engine, engine_notrim,
     # block); only round-2-spanning overlap is given up for the round-1
     # pass.
     _commit_pending_qc(qc_exec, pending_qc, timer)
-    fastx.write_fasta(merged_path, merged_consensus)
+    n_written = fastx.write_fasta(merged_path, merged_consensus)
+    contracts.check_equal(
+        "consensus", "merged_consensus.fasta records written", n_written,
+        "in-memory consensus entries", len(merged_consensus),
+        detail={"library": library},
+    )
     if not failed_groups:
         # incomplete round 1 is NOT checkpointed: resume must retry the
         # failed groups instead of reusing a consensus missing them
@@ -867,7 +911,14 @@ def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
             for region, err in failed_regions:
                 fh.write(f"{region}\t{err}\n")
 
-    stages.write_counts_csv(region_counts, lay.counts)
+    counts_csv = stages.write_counts_csv(region_counts, lay.counts)
+    # counts conservation: the CSV on disk must read back exactly as the
+    # in-memory per-region cluster totals it was written from
+    contracts.check_equal(
+        "counts", "counts CSV readback", _read_counts_csv(counts_csv),
+        "in-memory region counts", region_counts,
+        detail={"library": library},
+    )
     if cfg.compare_umi_overlap_between_regions:
         _log("Testing for consensus umi matches between regions:", library)
         umi_overlap.count_overlapping_umis(

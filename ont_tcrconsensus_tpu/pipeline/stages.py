@@ -22,7 +22,7 @@ from ont_tcrconsensus_tpu.cluster import umi as umi_mod
 from ont_tcrconsensus_tpu.io import bucketing, fastx
 from ont_tcrconsensus_tpu.ops import consensus as consensus_mod
 from ont_tcrconsensus_tpu.ops import encode
-from ont_tcrconsensus_tpu.robustness import faults, retry
+from ont_tcrconsensus_tpu.robustness import contracts, faults, retry
 from ont_tcrconsensus_tpu.pipeline.assign import (  # noqa: F401  (re-exported)
     AlignStats,
     AssignEngine,
@@ -193,13 +193,20 @@ def cluster_and_select(
     clusters = umi_mod.cluster_umis(
         [r.combined for r in eligible], identity, mesh=mesh
     )
-    return _select_from_clusters(
+    selected, stat_rows = _select_from_clusters(
         eligible, clusters,
         min_reads_per_cluster=min_reads_per_cluster,
         max_reads_per_cluster=max_reads_per_cluster,
         balance_strands=balance_strands,
         identity=identity, mesh=mesh,
     )
+    # UMI conservation across the r5 rescue merge: the post-rescue cluster
+    # stats must still partition the eligible records exactly
+    contracts.check_equal(
+        "umi", "cluster-stats member total", sum(r["n"] for r in stat_rows),
+        "eligible UMI records", len(eligible),
+    )
+    return selected, stat_rows
 
 
 def cluster_and_select_grouped(
@@ -261,6 +268,13 @@ def cluster_and_select_grouped(
                 min_reads_per_cluster, max_reads_per_cluster,
                 balance_strands,
             )
+        # UMI conservation across the batched r5 rescue merge (contracts):
+        # rescue relabels clusters but must never create or lose members
+        contracts.check_equal(
+            "umi", "cluster-stats member total",
+            sum(r["n"] for r in stat_rows),
+            "eligible UMI records", len(recs), detail={"group": name},
+        )
         out[name] = (selected, stat_rows)
     return out
 

@@ -18,6 +18,16 @@ writes) need no config plumbing:
   at the next stage boundary, drains overlapped workers, and exits with
   every fully-committed checkpoint intact so ``resume=true`` continues
   byte-identically.
+- :mod:`.contracts` — stage-boundary conservation contracts: runtime
+  accounting invariants (reads ingested == assigned + filtered +
+  quarantined, UMI counts conserved across the rescue pass, consensus
+  records == selected clusters, counts CSV == in-memory totals) in
+  ``off|warn|strict`` modes, violations recorded in the same report.
 """
 
-from ont_tcrconsensus_tpu.robustness import faults, retry, shutdown  # noqa: F401
+from ont_tcrconsensus_tpu.robustness import (  # noqa: F401
+    contracts,
+    faults,
+    retry,
+    shutdown,
+)

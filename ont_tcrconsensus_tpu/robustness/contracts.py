@@ -1,0 +1,95 @@
+"""Stage-boundary conservation contracts (runtime accounting self-checks).
+
+The counts contract (BASELINE.md: UMI counts bit-identical to the CPU
+pipeline) had no runtime self-check that reads are actually conserved
+across the rescue/skip/degrade branches the robustness layer added. This
+module adds cheap invariant checks at every stage boundary:
+
+- **ingest**: records parsed == reads entering the device pass + reads
+  dropped by the length buckets (+ quarantined records, counted upstream)
+- **assign**: the fused-pass filter categories partition the batch total,
+  and the columnar store holds exactly the passing reads
+- **umi**: per-group cluster-stats member totals equal the eligible UMI
+  records — conserved across the r5 sub-threshold rescue merge
+- **consensus**: consensus records == selected clusters per (non-failed)
+  group, and the merged FASTA holds exactly those records
+- **counts**: the counts CSV reads back equal to the in-memory totals
+
+Modes (config key ``contracts``): ``off`` (checks skipped), ``warn``
+(default: violations logged + recorded in ``robustness_report.json``),
+``strict`` (violations additionally raise :class:`ContractViolation`,
+failing the run). A check is a handful of integer compares — warn mode is
+free on the hot path.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+
+MODES = ("off", "warn", "strict")
+
+_MODE = "warn"
+_lock = threading.Lock()
+_checked: dict[str, int] = {}
+_violated: dict[str, int] = {}
+
+
+class ContractViolation(RuntimeError):
+    """A conservation invariant failed under ``contracts=strict``."""
+
+
+def mode() -> str:
+    return _MODE
+
+
+def set_mode(new_mode: str) -> str:
+    global _MODE
+    if new_mode not in MODES:
+        raise ValueError(f"contracts mode {new_mode!r} not in {MODES}")
+    _MODE = new_mode
+    return _MODE
+
+
+def reset() -> None:
+    """Clear the per-run check/violation counters (run start)."""
+    with _lock:
+        _checked.clear()
+        _violated.clear()
+
+
+def summary() -> dict:
+    """{checked: {name: n}, violated: {name: n}} for the robustness report."""
+    with _lock:
+        return {"mode": _MODE, "checked": dict(_checked),
+                "violated": dict(_violated)}
+
+
+def check_equal(name: str, lhs_desc: str, lhs, rhs_desc: str, rhs,
+                detail: dict | None = None) -> bool:
+    """Assert ``lhs == rhs`` under the active mode; returns whether it held.
+
+    ``off`` skips entirely. Violations are recorded in the robustness
+    recorder (site ``contracts.<name>``), logged to stderr under ``warn``,
+    and raised as :class:`ContractViolation` under ``strict``.
+    """
+    if _MODE == "off":
+        return True
+    with _lock:
+        _checked[name] = _checked.get(name, 0) + 1
+    if lhs == rhs:
+        return True
+    with _lock:
+        _violated[name] = _violated.get(name, 0) + 1
+    message = (f"conservation contract {name!r} violated: "
+               f"{lhs_desc} ({lhs!r}) != {rhs_desc} ({rhs!r})")
+    from ont_tcrconsensus_tpu.robustness import retry
+
+    retry.recorder().record(
+        f"contracts.{name}", classification="contract", outcome="violation",
+        error=message, detail=detail,
+    )
+    if _MODE == "strict":
+        raise ContractViolation(message)
+    print(f"WARNING: {message}", file=sys.stderr)
+    return False

@@ -57,7 +57,17 @@ import threading
 
 ENV_VAR = "TCR_CHAOS"
 
-KINDS = ("transient", "oom", "error", "kill", "preempt", "torn")
+#: ``corrupt-input`` / ``truncate-file`` are FILE-level data faults: they
+#: fire through :func:`mutate_input` at ingest sites (the pipeline reads a
+#: seeded-mutated sibling copy of the input file; the original is never
+#: touched), exercising the record-quarantine path end to end.
+#: ``corrupt-input`` splices malformed records BETWEEN the real ones, so
+#: with ``on_bad_record=quarantine`` the clean-read subset — and therefore
+#: every downstream artifact — must stay byte-identical to an uncorrupted
+#: run. ``truncate-file`` cuts the file mid-stream (for ``.gz`` inputs:
+#: mid gzip stream), losing the tail.
+KINDS = ("transient", "oom", "error", "kill", "preempt", "torn",
+         "corrupt-input", "truncate-file")
 
 #: every injection point planted in the pipeline; arming an unknown site is
 #: an error so chaos-plan typos fail fast instead of silently never firing
@@ -69,6 +79,7 @@ KNOWN_SITES = frozenset({
     "overlap.worker",
     "layout.manifest_write",
     "run.round1_checkpoint",
+    "ingest.library_fastq",
 })
 
 KILL_EXIT_CODE = 137
@@ -230,6 +241,98 @@ def inject(site: str) -> None:
     spec = _PLAN.hit(site)
     if spec is not None:
         _fire(spec, site)
+
+
+#: malformed blocks spliced between records by ``corrupt-input``. Each is
+#: self-contained damage the tolerant parser quarantines WITHOUT eating a
+#: neighboring real record: the junk line resyncs at the next record, the
+#: length-mismatch and sub-Phred records consume exactly their own four
+#: lines, and the headerless fragment resyncs at the following '@' header.
+_CORRUPT_BLOCKS = (
+    b"THIS IS NOT A FASTQ LINE \xff\xfe\x00 chaos\n",
+    b"@chaos_len_mismatch\nACGTACGT\n+\nIII\n",
+    b"@chaos_bad_qual\nACGT\n+\n\x05\x05\x05\x05\n",
+    b"@chaos_headerless_fragment\nACGTACGTACGT\n",
+)
+
+
+def _read_file_bytes(path: str) -> tuple[bytes, bool]:
+    """(decoded text bytes, was_gzip) — gzip-transparent like the parsers."""
+    import gzip
+
+    with open(path, "rb") as fh:
+        raw = fh.read()
+    if raw[:2] == b"\x1f\x8b":
+        return gzip.decompress(raw), True
+    return raw, False
+
+
+def _chaos_sibling_path(path: str, tag: str) -> str:
+    """Mutated-copy path next to ``path``: '<stem>.<tag>[.gz]'. The name
+    must NOT contain 'fastq' — input discovery globs '*fastq*'
+    (pipeline/run.py), and a leftover chaos copy must never be picked up
+    as an extra library on a later resume."""
+    d, base = os.path.split(path)
+    # ONT's standard naming puts 'fastq' in the STEM too (fastq_runid_*),
+    # so the stem itself must be scrubbed, not just the extensions
+    stem = base.split(".")[0].replace("fastq", "fq")
+    suffix = ".gz" if path.endswith(".gz") else ""
+    return os.path.join(d, f"{stem}.{tag}{suffix}")
+
+
+def mutate_input(site: str, path: str) -> str:
+    """File-level chaos for ingest sites: returns the path to read.
+
+    When a ``corrupt-input`` / ``truncate-file`` spec fires at ``site``, a
+    mutated sibling copy is written next to ``path`` (named without
+    'fastq' so input discovery never globs it on a resume) and its path is
+    returned; the original file is never modified. Other armed kinds fire
+    through :func:`_fire` as usual. No-op (returns ``path``) when
+    disarmed.
+    """
+    if _PLAN is None:
+        return path
+    spec = _PLAN.hit(site)
+    if spec is None:
+        return path
+    if spec.kind not in ("corrupt-input", "truncate-file"):
+        _fire(spec, site)
+        return path
+    import gzip
+
+    rng = random.Random(f"{_PLAN.seed}:{site}:{spec.kind}")
+    if spec.kind == "truncate-file":
+        # cut the RAW file bytes mid-stream: for .gz inputs this truncates
+        # the gzip stream itself (the BadGzipFile/gzread-error path)
+        with open(path, "rb") as fh:
+            raw = fh.read()
+        cut = max(1, int(len(raw) * (0.5 + 0.3 * rng.random())))
+        out_path = _chaos_sibling_path(path, "chaos-trunc")
+        with open(out_path, "wb") as fh:
+            fh.write(raw[:cut])
+        sys.stderr.write(f"CHAOS: truncated input copy {out_path} "
+                         f"({cut}/{len(raw)} bytes) at {site}\n")
+        return out_path
+    data, was_gz = _read_file_bytes(path)
+    lines = data.splitlines(keepends=True)
+    # record boundaries every 4 lines (chaos stages well-formed FASTQ)
+    n_rec = len(lines) // 4
+    slots = sorted(rng.sample(range(n_rec + 1), k=min(3, n_rec + 1)))
+    parts: list[bytes] = []
+    prev = 0
+    for k, slot in enumerate(slots):
+        parts.append(b"".join(lines[prev * 4:slot * 4]))
+        parts.append(_CORRUPT_BLOCKS[(k + rng.randrange(len(_CORRUPT_BLOCKS)))
+                                     % len(_CORRUPT_BLOCKS)])
+        prev = slot
+    parts.append(b"".join(lines[prev * 4:]))
+    mutated = b"".join(parts)
+    out_path = _chaos_sibling_path(path, "chaos-corrupt")
+    with open(out_path, "wb") as fh:
+        fh.write(gzip.compress(mutated) if was_gz else mutated)
+    sys.stderr.write(f"CHAOS: corrupted input copy {out_path} "
+                     f"({len(slots)} bad blocks) at {site}\n")
+    return out_path
 
 
 def tear_write(site: str, path: str, payload: str) -> bool:

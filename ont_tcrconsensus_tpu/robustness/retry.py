@@ -146,12 +146,17 @@ class RobustnessRecorder:
                 s[key][v] = s[key].get(v, 0) + 1
         return out
 
-    def write(self, path: str, policy: "RetryPolicy | None" = None) -> None:
+    def write(self, path: str, policy: "RetryPolicy | None" = None,
+              contracts: dict | None = None) -> None:
         with self._lock:
             events = list(self.events)
         report = {
             "policy": dataclasses.asdict(policy) if policy is not None else None,
             "chaos": faults.describe(),
+            # conservation-contract counters (robustness/contracts.py): a
+            # top-level summary, NOT events — only actual violations appear
+            # in sites/events, so a clean run's event log stays empty
+            "contracts": contracts,
             "sites": self.summary(),
             "events": events,
         }

@@ -1,13 +1,17 @@
 #!/usr/bin/env bash
-# Tier-1 gate (ROADMAP.md "Tier-1 verify") + a fast chaos smoke.
+# Tier-1 gate (ROADMAP.md "Tier-1 verify") + a fast chaos smoke + a seeded
+# ingest-fuzz smoke.
 #
 # Usage: scripts/tier1.sh [--no-chaos]
 #
 # Stage 1 is the exact ROADMAP tier-1 command: the full non-slow suite on
-# the CPU backend (this already includes the non-slow chaos scenarios).
-# Stage 2 re-runs ONLY the fast chaos subset (-m 'chaos and not slow') so
-# a robustness regression is named explicitly in CI output instead of
-# drowning in the full run. Pass --no-chaos to skip stage 2.
+# the CPU backend (this already includes the non-slow chaos scenarios and
+# the 5-seed fuzz smoke). Stage 2 re-runs ONLY the fast chaos subset
+# (-m 'chaos and not slow') so a robustness regression is named explicitly
+# in CI output instead of drowning in the full run; pass --no-chaos to
+# skip it. Stage 3 re-runs the differential ingest fuzzer standalone
+# (5 seeds; the >=1000-corpus campaign is the slow-marked test or
+# `python scripts/fuzz_ingest.py --cases 250`).
 
 set -o pipefail
 cd "$(dirname "$0")/.."
@@ -32,5 +36,13 @@ if [ "${1:-}" != "--no-chaos" ]; then
         echo "chaos smoke FAILED (rc=$crc)" >&2
         exit "$crc"
     fi
+fi
+
+echo "--- ingest fuzz smoke (native vs Python differential, 5 seeds) ---"
+timeout -k 10 300 python scripts/fuzz_ingest.py --seeds 5 --cases 20
+frc=$?
+if [ "$frc" -ne 0 ]; then
+    echo "ingest fuzz smoke FAILED (rc=$frc)" >&2
+    exit "$frc"
 fi
 echo "tier-1 OK"

@@ -305,6 +305,70 @@ def test_chaos_process_kill_midstage_resume_byte_identical(chaos_lib, tmp_path):
     _assert_byte_identical(chaos_lib, root)
 
 
+def test_chaos_corrupt_input_quarantines_and_stays_byte_identical(chaos_lib, tmp_path):
+    """File-level data fault (ISSUE 3): malformed records spliced into the
+    lane mid-file. With on_bad_record=quarantine the run completes, the
+    damage lands in quarantine.fastq.gz + robustness_report.json, and the
+    clean-read subset's counts CSV and consensus FASTA are byte-identical
+    to an uncorrupted run — under contracts=strict."""
+    root = tmp_path / "corrupt"
+    _stage_inputs(chaos_lib["inputs"], root)
+    results = run_with_config(_cfg(root, on_bad_record="quarantine",
+                                   contracts="strict", chaos=[
+        {"site": "ingest.library_fastq", "kind": "corrupt-input"},
+    ]))
+    assert results["barcode01"] == chaos_lib["baseline_counts"]
+    assert faults.fired("ingest.library_fastq") == 1
+    _assert_byte_identical(chaos_lib, root)
+    # quarantine artifact holds the spliced damage
+    lib_dir = root / "fastq_pass" / "nano_tcr" / "barcode01"
+    q = lib_dir / "quarantine.fastq.gz"
+    assert q.exists()
+    import gzip as gzip_mod
+
+    quarantined = gzip_mod.open(q, "rb").read()
+    assert b"chaos" in quarantined
+    # machine-readable reasons in the robustness report
+    report = _report(root)
+    site = report["sites"]["ingest.quarantine"]
+    assert site["by_outcome"]["quarantined"] >= 3
+    summary = next(e for e in report["events"]
+                   if e["site"] == "ingest.quarantine"
+                   and e["outcome"] == "summary")
+    assert summary["detail"]["n_bad"] >= 3
+    # strict contracts all held (summary recorded, zero violations)
+    csum = report["contracts"]
+    assert csum["mode"] == "strict"
+    assert csum["violated"] == {}
+    assert csum["checked"]["ingest"] >= 1
+    # the original input was never touched (only a .chaos sibling was read)
+    assert (root / "fastq_pass" / "barcode01" / "barcode01.fastq.gz").read_bytes() \
+        == (chaos_lib["inputs"] / "fastq_pass" / "barcode01"
+            / "barcode01.fastq.gz").read_bytes()
+
+
+@pytest.mark.slow
+def test_chaos_truncate_file_quarantines_gzip_tail(chaos_lib, tmp_path):
+    """truncate-file cuts the .gz mid-stream: the run must complete on the
+    decodable prefix with the gzip truncation recorded as a quarantine
+    event — reads in the lost tail are gone, so artifacts may differ, but
+    nothing crashes and the loss is auditable."""
+    root = tmp_path / "trunc"
+    _stage_inputs(chaos_lib["inputs"], root)
+    results = run_with_config(_cfg(root, on_bad_record="quarantine", chaos=[
+        {"site": "ingest.library_fastq", "kind": "truncate-file"},
+    ]))
+    assert faults.fired("ingest.library_fastq") == 1
+    assert "barcode01" in results  # the library completed
+    report = _report(root)
+    reasons = [e["detail"].get("reason", "") for e in report["events"]
+               if e["site"] == "ingest.quarantine" and "detail" in e]
+    assert any("gzip" in r for r in reasons)
+    counts = root / "fastq_pass" / "nano_tcr" / "barcode01" / "counts" / \
+        "umi_consensus_counts.csv"
+    assert counts.exists()
+
+
 def test_chaos_disarmed_run_writes_clean_report(chaos_lib):
     """The A/B guard: with nothing armed the baseline run's report exists
     and records zero events — the robustness layer is pure bookkeeping on
@@ -313,6 +377,11 @@ def test_chaos_disarmed_run_writes_clean_report(chaos_lib):
     assert report["sites"] == {}
     assert report["events"] == []
     assert report["policy"]["max_attempts"] >= 1
+    # conservation contracts ran (warn mode default) and all held — the
+    # summary is a top-level field, never an event, on the clean path
+    assert report["contracts"]["mode"] == "warn"
+    assert report["contracts"]["violated"] == {}
+    assert report["contracts"]["checked"]["counts"] >= 1
     # SIGTERM disposition was restored: the run's coordinator is gone
     handler = signal.getsignal(signal.SIGTERM)
     owner = getattr(handler, "__self__", None)

@@ -1,0 +1,65 @@
+"""Stage-boundary conservation contracts (robustness/contracts.py)."""
+
+import pytest
+
+from ont_tcrconsensus_tpu.robustness import contracts, retry
+
+
+@pytest.fixture(autouse=True)
+def _restore_mode():
+    prev = contracts.mode()
+    yield
+    contracts.set_mode(prev)
+    contracts.reset()
+
+
+def test_warn_mode_records_violation_without_raising(capsys):
+    contracts.set_mode("warn")
+    contracts.reset()
+    rec = retry.recorder()
+    rec.reset()
+    assert contracts.check_equal("ingest", "parsed", 10, "batched", 10)
+    assert not contracts.check_equal("ingest", "parsed", 10, "batched", 9,
+                                     detail={"source": "x.fastq"})
+    assert "conservation contract 'ingest' violated" in capsys.readouterr().err
+    s = contracts.summary()
+    assert s["checked"]["ingest"] == 2
+    assert s["violated"]["ingest"] == 1
+    ev = [e for e in rec.events if e["site"] == "contracts.ingest"]
+    assert len(ev) == 1 and ev[0]["outcome"] == "violation"
+    assert ev[0]["detail"] == {"source": "x.fastq"}
+
+
+def test_strict_mode_raises():
+    contracts.set_mode("strict")
+    contracts.reset()
+    with pytest.raises(contracts.ContractViolation, match="counts"):
+        contracts.check_equal("counts", "csv", {"a": 1}, "memory", {"a": 2})
+
+
+def test_off_mode_skips_entirely():
+    contracts.set_mode("off")
+    contracts.reset()
+    assert contracts.check_equal("umi", "lhs", 1, "rhs", 2)  # not even counted
+    assert contracts.summary()["checked"] == {}
+
+
+def test_unknown_mode_rejected():
+    with pytest.raises(ValueError, match="contracts mode"):
+        contracts.set_mode("loose")
+
+
+def test_config_wires_policy_and_contract_keys():
+    from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+
+    cfg = RunConfig.from_dict({
+        "reference_file": "r", "fastq_pass_dir": "f",
+        "on_bad_record": "quarantine", "contracts": "strict",
+    })
+    assert cfg.on_bad_record == "quarantine" and cfg.contracts == "strict"
+    with pytest.raises(ValueError, match="on_bad_record"):
+        RunConfig.from_dict({"reference_file": "r", "fastq_pass_dir": "f",
+                             "on_bad_record": "ignore"})
+    with pytest.raises(ValueError, match="contracts"):
+        RunConfig.from_dict({"reference_file": "r", "fastq_pass_dir": "f",
+                             "contracts": "paranoid"})

@@ -58,6 +58,64 @@ def test_malformed_raises(tmp_path):
         native.parse_file(str(path))
 
 
+def _clean_parity_cases():
+    """Clean-input edge cases previously only exercised implicitly through
+    e2e: header edge cases, FASTA multiline, gz vs plain (ISSUE 3)."""
+    return [
+        ("header_comment", "x.fastq",
+         b"@r1 runid=abc ch=1\nACGT\n+\nIIII\n@r2 c=2\nGG\n+comment\nII\n"),
+        ("empty_seq_record", "x.fastq", b"@r1\n\n+\n\n@r2\nAC\n+\nII\n"),
+        ("lowercase_and_n", "x.fastq", b"@r1\nacgtnN\n+\nIIIIII\n"),
+        ("fasta_multiline", "x.fasta",
+         b">a first desc\nACGT\nTTTT\nGG\n>b\nCCCC\n\n>c trailing\nAA"),
+        ("crlf_fastq", "x.fastq", b"@r1\r\nACGT\r\n+\r\nIIII\r\n"),
+        ("blank_separated", "x.fastq", b"@r1\nACGT\n+\nIIII\n\n\n@r2\nGG\n+\nII\n"),
+    ]
+
+
+@pytest.mark.parametrize("label,name,data",
+                         _clean_parity_cases(),
+                         ids=[c[0] for c in _clean_parity_cases()])
+@pytest.mark.parametrize("gz", [False, True], ids=["plain", "gz"])
+def test_native_matches_python_on_clean_edge_cases(tmp_path, label, name, data, gz):
+    """Native vs pure-Python parity on CLEAN inputs, .gz and plain; the
+    tolerant parse must agree with the strict one (records identical, zero
+    bad regions) so the quarantine path costs nothing on healthy data."""
+    import gzip
+
+    from ont_tcrconsensus_tpu.io import validate as validate_mod
+
+    path = tmp_path / (name + (".gz" if gz else ""))
+    path.write_bytes(gzip.compress(data) if gz else data)
+    _compare(str(path))
+    strict = native.parse_file(str(path))
+    tol = native.parse_file(str(path), tolerant=True)
+    assert tol.bad == []
+    assert tol.num_records == strict.num_records
+    np.testing.assert_array_equal(tol.codes, strict.codes)
+    assert tol.names == strict.names
+    py_recs, py_bads = validate_mod.parse_path_tolerant(str(path))
+    assert not py_bads
+    assert [r.header.decode() for r in py_recs] == tol.names
+
+
+def test_truncated_gzip_rejected_strict_kept_tolerant(tmp_path):
+    """gzread reports truncation only via gzerror (not its return value):
+    the strict parser must reject a truncated .gz instead of silently
+    accepting the prefix — the fuzzer caught the original silent accept."""
+    import gzip
+
+    payload = gzip.compress(b"".join(
+        b"@r%d\nACGTACGT\n+\nIIIIIIII\n" % i for i in range(100)))
+    path = tmp_path / "t.fastq.gz"
+    path.write_bytes(payload[: len(payload) // 2])
+    with pytest.raises(ValueError, match="gzip"):
+        native.parse_file(str(path))
+    tol = native.parse_file(str(path), tolerant=True)
+    assert tol.num_records > 0
+    assert any("gzip" in reason for _, reason, _ in tol.bad)
+
+
 def test_large_roundtrip_speed(tmp_path):
     import time
 

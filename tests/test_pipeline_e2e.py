@@ -51,6 +51,10 @@ def _base_config(tmp):
         "read_batch_size": 64,
         "polish_method": "poa",
         "delete_tmp_files": False,
+        # strict conservation contracts on the clean e2e path: any
+        # accounting drift across ingest/assign/umi/consensus/counts
+        # fails these tests instead of warning (ISSUE 3 acceptance)
+        "contracts": "strict",
     })
 
 
@@ -277,6 +281,46 @@ def test_pipeline_degrades_gracefully_on_poisoned_group(sim_library, tmp_path, m
     for region, c in cluster_map.items():
         if c == 0:
             assert region not in got
+
+
+def test_pipeline_empty_and_zero_survivor_libraries(tmp_path):
+    """Empty-input edge cases (ISSUE 3 satellite): an empty FASTQ and a
+    library whose reads all fail the length gate must both complete with
+    empty-but-valid artifacts — and pass strict contracts + quarantine
+    policy. Regions with zero clusters simply emit no counts rows."""
+    fastx.write_fasta(tmp_path / "reference.fa",
+                      [("regionA", "ACGT" * 200), ("regionB", "GGCATT" * 150)])
+    fq1 = tmp_path / "fastq_pass" / "barcode01"
+    fq2 = tmp_path / "fastq_pass" / "barcode02"
+    fq1.mkdir(parents=True)
+    fq2.mkdir(parents=True)
+    (fq1 / "barcode01.fastq").write_bytes(b"")  # empty input file
+    # all reads far below minimal_length: 0 survivors after the gate
+    fastx.write_fastq(fq2 / "barcode02.fastq.gz",
+                      [(f"r{i}", "ACGT" * 10, "I" * 40) for i in range(8)])
+    cfg = RunConfig.from_dict({
+        "reference_file": str(tmp_path / "reference.fa"),
+        "fastq_pass_dir": str(tmp_path / "fastq_pass"),
+        "minimal_length": 600,
+        "min_reads_per_cluster": 4,
+        "read_batch_size": 64,
+        "polish_method": "poa",
+        "delete_tmp_files": False,
+        "contracts": "strict",
+        "on_bad_record": "quarantine",
+    })
+    results = run_with_config(cfg)
+    assert results == {"barcode01": {}, "barcode02": {}}
+    for lib in ("barcode01", "barcode02"):
+        lib_dir = tmp_path / "fastq_pass" / "nano_tcr" / lib
+        csv = lib_dir / "counts" / "umi_consensus_counts.csv"
+        assert csv.read_text() == "TCR,Count\n"  # empty-but-valid artifact
+        merged = lib_dir / "fasta" / "merged_consensus.fasta"
+        assert merged.exists() and merged.read_text() == ""
+        manifest = json.loads((lib_dir / "stage_manifest.json").read_text())
+        assert "counts" in manifest  # complete (not failed/skipped)
+        # nothing was quarantined: the inputs were clean, just empty/short
+        assert not (lib_dir / "quarantine.fastq.gz").exists()
 
 
 def test_mesh_batch_divisibility_validated(sim_library):

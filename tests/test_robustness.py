@@ -315,3 +315,67 @@ def test_preempt_chaos_kind_triggers_active_coordinator():
         faults.inject("run.round1_checkpoint")  # requests, does not raise
         with pytest.raises(shutdown.Preempted):
             shutdown.checkpoint("run.round1_checkpoint")
+
+
+def test_mutate_input_corrupt_copy_preserves_clean_records(tmp_path):
+    """corrupt-input writes a seeded mutated SIBLING (original untouched)
+    whose clean records are byte-identical, and is deterministic per plan
+    seed; disarmed, mutate_input is a pass-through."""
+    from ont_tcrconsensus_tpu.io import fastx
+
+    src = tmp_path / "lib.fastq.gz"
+    reads = [(f"r{i}", "ACGT" * 30, "I" * 120) for i in range(10)]
+    fastx.write_fastq(src, reads)
+    original = src.read_bytes()
+
+    assert faults.mutate_input("ingest.library_fastq", str(src)) == str(src)
+
+    faults.arm([{"site": "ingest.library_fastq", "kind": "corrupt-input"}], seed=7)
+    out = faults.mutate_input("ingest.library_fastq", str(src))
+    assert out != str(src) and out.endswith(".gz")
+    assert src.read_bytes() == original  # never modified in place
+    clean = [(r.header, r.sequence, r.quality)
+             for r in fastx.read_fastx(src)]
+    from ont_tcrconsensus_tpu.io import validate as validate_mod
+
+    recs, bads = validate_mod.parse_path_tolerant(out)
+    kept = [(r.header.decode(), r.seq.decode(), r.qual.decode()) for r in recs
+            if not r.header.startswith(b"chaos_")]
+    assert kept == clean
+    assert len(bads) == 3  # the three spliced blocks, all quarantined
+    mutated_once = open(out, "rb").read()
+    faults.arm([{"site": "ingest.library_fastq", "kind": "corrupt-input"}], seed=7)
+    assert open(faults.mutate_input("ingest.library_fastq", str(src)),
+                "rb").read() == mutated_once  # seeded determinism
+    faults.disarm()
+
+
+def test_mutate_input_truncate_file(tmp_path):
+    from ont_tcrconsensus_tpu.io import fastx
+
+    src = tmp_path / "lib.fastq.gz"
+    fastx.write_fastq(src, [(f"r{i}", "ACGT" * 50, "I" * 200) for i in range(50)])
+    faults.arm([{"site": "ingest.library_fastq", "kind": "truncate-file"}])
+    out = faults.mutate_input("ingest.library_fastq", str(src))
+    assert out.endswith(".gz") and os.path.getsize(out) < os.path.getsize(src)
+    from ont_tcrconsensus_tpu.io import validate as validate_mod
+
+    recs, bads = validate_mod.parse_path_tolerant(out)
+    assert recs, "decodable prefix lost"
+    assert any(b.reason == validate_mod.R_GZIP for b in bads)
+    faults.disarm()
+
+
+def test_chaos_sibling_path_never_contains_fastq(tmp_path):
+    """ONT's standard naming puts 'fastq' in the stem (fastq_runid_*); the
+    chaos copy's name must still evade the '*fastq*' input-discovery glob
+    or a leftover copy becomes an extra library on resume."""
+    from ont_tcrconsensus_tpu.io import fastx
+
+    src = tmp_path / "fastq_runid_abc_0.fastq.gz"
+    fastx.write_fastq(src, [("r1", "ACGT" * 30, "I" * 120)])
+    faults.arm([{"site": "ingest.library_fastq", "kind": "corrupt-input"}])
+    out = faults.mutate_input("ingest.library_fastq", str(src))
+    assert "fastq" not in os.path.basename(out)
+    assert out.endswith(".gz")
+    faults.disarm()

@@ -1,0 +1,235 @@
+"""Record-level validation + quarantine (io/validate.py) and the gzip
+error-context satellite (io/fastx.py)."""
+
+import gzip
+import json
+
+import numpy as np
+import pytest
+
+from ont_tcrconsensus_tpu.io import bucketing, fastx
+from ont_tcrconsensus_tpu.io import validate as V
+
+
+# --- tolerant parser unit behavior -----------------------------------------
+
+
+def test_tolerant_parser_resyncs_and_keeps_clean_records(tmp_path):
+    data = (b"@r1\nACGT\n+\nIIII\n"
+            b"junk line that is not a record\n"
+            b"@bad\nACG\n+\nIIII\n"          # len mismatch: 4-line quarantine
+            b"@r2\nGG\n+\nII\n")
+    p = tmp_path / "x.fastq"
+    p.write_bytes(data)
+    records, bads = V.parse_path_tolerant(p)
+    assert [r.header for r in records] == [b"r1", b"r2"]
+    assert [b.reason for b in bads] == [V.R_BAD_HEADER, V.R_LEN_MISMATCH]
+    # offsets are absolute and raw bytes reconstruct the damage exactly
+    assert data[bads[0].offset:].startswith(b"junk line")
+    assert bads[1].raw == b"@bad\nACG\n+\nIIII\n"
+
+
+def test_tolerant_parser_missing_plus_resync(tmp_path):
+    # r1 truncated mid-record: its 'plus' slot holds r2's header, so the
+    # parser must give r1 up WITHOUT eating r2
+    p = tmp_path / "x.fastq"
+    p.write_bytes(b"@r1\nACGT\n@r2\nGGCC\n+\nIIII\n")
+    records, bads = V.parse_path_tolerant(p)
+    assert [r.header for r in records] == [b"r2"]
+    assert [b.reason for b in bads] == [V.R_MISSING_PLUS]
+
+
+def test_tolerant_parser_truncated_final_record(tmp_path):
+    p = tmp_path / "x.fastq"
+    p.write_bytes(b"@r1\nACGT\n+\nIIII\n@r2\nACGT\n+")
+    records, bads = V.parse_path_tolerant(p)
+    assert [r.header for r in records] == [b"r1"]
+    assert [b.reason for b in bads] == [V.R_TRUNCATED]
+    assert bads[0].offset == len(b"@r1\nACGT\n+\nIIII\n")
+
+
+def test_tolerant_parser_subphred_and_gzip_truncation(tmp_path):
+    text = b"".join(b"@r%d\nACGTACGTAC\n+\nIIIIIIIIII\n" % i for i in range(50))
+    full = gzip.compress(text)
+    p = tmp_path / "x.fastq.gz"
+    p.write_bytes(full[: len(full) // 2])
+    records, bads = V.parse_path_tolerant(p)
+    assert records, "decodable prefix lost"
+    assert bads[-1].reason == V.R_GZIP
+    # sub-Phred33 quarantines the record, clean neighbors survive
+    p2 = tmp_path / "y.fastq"
+    p2.write_bytes(b"@a\nAC\n+\n\x1f\x1f\n@b\nGG\n+\nII\n")
+    records, bads = V.parse_path_tolerant(p2)
+    assert [r.header for r in records] == [b"b"]
+    assert [b.reason for b in bads] == [V.R_BAD_QUAL]
+
+
+def test_code_lut_matches_ops_encode():
+    """validate.CODE_LUT is a jax-free mirror of ops.encode._CODE_LUT; the
+    two must never drift (the fuzzer encodes with the mirror)."""
+    from ont_tcrconsensus_tpu.ops import encode
+
+    np.testing.assert_array_equal(V.CODE_LUT, encode._CODE_LUT)
+
+
+# --- IngestGuard ------------------------------------------------------------
+
+
+def test_ingest_guard_quarantine_artifact_and_reset(tmp_path):
+    qpath = str(tmp_path / "quarantine.fastq.gz")
+    guard = V.IngestGuard("quarantine", source="lib.fastq", quarantine_path=qpath)
+    guard.handle(V.BadRecord(0, V.R_LEN_MISMATCH, b"@bad\nACG\n+\nIIII\n", "lib.fastq"))
+    guard.handle(V.BadRecord(40, V.R_BAD_HEADER, b"junk\n", "lib.fastq"))
+    # retry semantics: reset truncates the artifact and zeroes counters
+    guard.reset()
+    assert guard.n_bad == 0
+    guard.handle(V.BadRecord(0, V.R_LEN_MISMATCH, b"@bad\nACG\n+\nIIII\n", "lib.fastq"))
+
+    class Rec:
+        def __init__(self):
+            self.events = []
+
+        def record(self, site, **kw):
+            self.events.append((site, kw))
+
+    rec = Rec()
+    summary = guard.finalize(rec)
+    assert summary["n_bad"] == 1
+    assert summary["by_reason"] == {V.R_LEN_MISMATCH: 1}
+    assert gzip.open(qpath, "rb").read() == b"@bad\nACG\n+\nIIII\n"
+    outcomes = [kw["outcome"] for _, kw in rec.events]
+    assert outcomes == ["quarantined", "summary"]
+    # finalize is idempotent: no duplicate report events
+    guard.finalize(rec)
+    assert len(rec.events) == 2
+
+
+def test_ingest_guard_drop_policy_writes_no_artifact(tmp_path):
+    guard = V.IngestGuard("drop", source="x",
+                          quarantine_path=str(tmp_path / "q.gz"))
+    assert guard.quarantine_path is None
+    guard.handle(V.BadRecord(0, V.R_BAD_HEADER, b"junk\n", "x"))
+    assert guard.finalize()["n_bad"] == 1
+    assert not (tmp_path / "q.gz").exists()
+
+
+# --- run_assign integration (guard + ingest contracts, engine-free) --------
+
+
+def test_batches_from_source_quarantines_bad_records(tmp_path):
+    """The ingest path (native chunked parser, or Python fallback) must
+    yield only the clean records and route the damage to the guard."""
+    from ont_tcrconsensus_tpu.pipeline.assign import _batches_from_source
+
+    p = tmp_path / "lib.fastq"
+    p.write_bytes(b"@r1\n" + b"A" * 100 + b"\n+\n" + b"I" * 100 + b"\n"
+                  b"garbage here\n"
+                  b"@r2\n" + b"C" * 100 + b"\n+\n" + b"I" * 99 + b"\n"
+                  b"@r3\n" + b"G" * 100 + b"\n+\n" + b"I" * 100 + b"\n")
+    guard = V.IngestGuard("quarantine", source=str(p),
+                          quarantine_path=str(tmp_path / "q.gz"))
+    counters = bucketing.IngestCounters()
+    batches = list(_batches_from_source(
+        str(p), batch_size=8, widths=(256,), subsample=None,
+        counters=counters, guard=guard,
+    ))
+    ids = [i for b in batches for i, v in zip(b.ids, b.valid) if v]
+    assert ids == ["r1", "r3"]
+    assert counters.n_records == 2
+    assert guard.n_bad == 2
+    assert set(guard.by_reason) == {V.R_BAD_HEADER, V.R_LEN_MISMATCH}
+
+
+def test_batches_from_source_fail_policy_still_raises(tmp_path):
+    from ont_tcrconsensus_tpu.pipeline.assign import _batches_from_source
+
+    p = tmp_path / "lib.fastq"
+    p.write_bytes(b"@r1\nACGT\n+\nII\n")
+    with pytest.raises(ValueError):
+        list(_batches_from_source(str(p), batch_size=8, widths=(256,),
+                                  subsample=None))
+
+
+# --- gzip error-context satellite ------------------------------------------
+
+
+def test_read_fastx_truncated_gzip_has_context(tmp_path):
+    text = b"".join(b"@r%d\nACGTACGTAC\n+\nIIIIIIIIII\n" % i for i in range(200))
+    full = gzip.compress(text)
+    p = tmp_path / "trunc.fastq.gz"
+    p.write_bytes(full[: len(full) // 2])
+    with pytest.raises(ValueError) as ei:
+        list(fastx.read_fastx(p))
+    msg = str(ei.value)
+    assert "trunc.fastq.gz" in msg
+    assert "gzip" in msg and "offset" in msg
+
+
+def test_read_fastx_empty_gzip_is_empty(tmp_path):
+    # a ZERO-byte .gz reads as a valid empty archive (gzip module semantics,
+    # matching the native parser's gzopen transparency): no records, no error
+    p = tmp_path / "empty.fastq.gz"
+    p.write_bytes(b"")
+    assert list(fastx.read_fastx(p)) == []
+
+
+def test_read_fastx_garbage_gzip_has_context(tmp_path):
+    # a .gz whose member header is cut mid-way IS a decode error with context
+    p = tmp_path / "garbage.fastq.gz"
+    p.write_bytes(b"\x1f\x8b\x08")
+    with pytest.raises(ValueError, match="gzip"):
+        list(fastx.read_fastx(p))
+
+
+# --- --validate dry-run -----------------------------------------------------
+
+
+def _write_config(tmp_path, **overrides):
+    ref = tmp_path / "reference.fa"
+    fastx.write_fasta(ref, [("regionA", "ACGT" * 200), ("regionB", "GGCC" * 200)])
+    fq_dir = tmp_path / "fastq_pass" / "barcode01"
+    fq_dir.mkdir(parents=True, exist_ok=True)
+    fastx.write_fastq(fq_dir / "barcode01.fastq.gz",
+                      [("r1", "ACGT" * 100, "I" * 400)])
+    cfg = {
+        "reference_file": str(ref),
+        "fastq_pass_dir": str(tmp_path / "fastq_pass"),
+    }
+    cfg.update(overrides)
+    cfg_path = tmp_path / "config.json"
+    cfg_path.write_text(json.dumps(cfg))
+    return cfg_path, fq_dir
+
+
+def test_validate_cli_ok(tmp_path, capsys):
+    from ont_tcrconsensus_tpu.pipeline import cli
+
+    cfg_path, _ = _write_config(tmp_path)
+    assert cli.main([str(cfg_path), "--validate"]) == 0
+    out = capsys.readouterr().out
+    assert "validate: OK" in out
+    assert "1 records" in out
+
+
+def test_validate_cli_flags_bad_records(tmp_path, capsys):
+    from ont_tcrconsensus_tpu.pipeline import cli
+
+    cfg_path, fq_dir = _write_config(tmp_path)
+    (fq_dir / "bad.fastq").write_bytes(b"@r1\nACGT\n+\nII\n")
+    assert cli.main([str(cfg_path), "--validate"]) == 1
+    out = capsys.readouterr().out
+    assert "PROBLEM" in out and V.R_LEN_MISMATCH in out
+    assert "validate: FAIL" in out
+
+
+def test_validate_cli_flags_config_and_missing_inputs(tmp_path, capsys):
+    from ont_tcrconsensus_tpu.pipeline import cli
+
+    bad_cfg = tmp_path / "bad.json"
+    bad_cfg.write_text(json.dumps({"reference_file": "r.fa"}))  # missing key
+    assert cli.main([str(bad_cfg), "--validate"]) == 1
+    assert "config failed" in capsys.readouterr().out
+
+    cfg_path, _ = _write_config(tmp_path, reference_file=str(tmp_path / "nope.fa"))
+    assert cli.main([str(cfg_path), "--validate"]) == 1
+    assert "unreadable" in capsys.readouterr().out
