@@ -5,6 +5,14 @@ g++ on first import (cached next to the source); when no compiler/zlib is
 available every consumer silently falls back to the pure-Python parser in
 :mod:`..fastx`, which has identical semantics (the native parser's contract
 is pinned by tests that compare the two).
+
+Sanitized builds: ``GRAFT_SANITIZE=address,undefined`` (any
+``-fsanitize=`` value) switches every build — install-time (setup.py) and
+build-on-first-use alike — to ``-O1 -g -fsanitize=... -fno-omit-frame-
+pointer``. An ASan library only loads into a process that preloaded the
+ASan runtime, so the sanitized fuzz replay re-execs itself under
+``LD_PRELOAD=libasan.so`` (scripts/fuzz_ingest.py --sanitized) with
+``GRAFT_FASTX_LIB`` pointing the loader at the sanitized artifact.
 """
 
 from __future__ import annotations
@@ -23,29 +31,85 @@ _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _build_failed = False
 
+#: the native build is first-party C++ now, not vendored glue: it compiles
+#: warning-clean and stays that way (tools/graftlint's native complement)
+WARN_FLAGS = ("-Wall", "-Wextra")
+
+SANITIZE_ENV = "GRAFT_SANITIZE"  # e.g. "address,undefined"
+LIB_OVERRIDE_ENV = "GRAFT_FASTX_LIB"  # load exactly this .so, never build
+
+
+def build_command(src: str, out: str, sanitize: str | None = None) -> list[str]:
+    """The g++ command line for ``src`` -> ``out`` (shared with setup.py).
+
+    ``sanitize`` is a ``-fsanitize=`` value ("address,undefined"); it
+    drops -O3 to -O1 and keeps frame pointers so reports carry usable
+    stacks.
+    """
+    if sanitize:
+        opt = ["-O1", "-g", f"-fsanitize={sanitize}", "-fno-omit-frame-pointer"]
+    else:
+        opt = ["-O3"]
+    return ["g++", *opt, *WARN_FLAGS, "-shared", "-fPIC", src, "-lz", "-o", out]
+
+
+def build_library(out_path: str, sanitize: str | None = None,
+                  timeout: int = 240) -> tuple[bool, str]:
+    """Compile the parser to ``out_path``; returns (ok, compiler output)."""
+    cmd = build_command(_SRC, out_path, sanitize=sanitize)
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout)
+        return proc.returncode == 0, (proc.stderr or proc.stdout or "")
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        return False, repr(exc)
+
+
+def asan_runtime_path() -> str | None:
+    """Path to g++'s libasan.so (to LD_PRELOAD); None when unavailable."""
+    try:
+        proc = subprocess.run(
+            ["g++", "-print-file-name=libasan.so"],
+            capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    path = proc.stdout.strip()
+    return path if proc.returncode == 0 and os.path.isabs(path) else None
+
 
 def _build() -> bool:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", _SRC, "-lz", "-o", _LIB]
-    try:
-        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=240)
-        return proc.returncode == 0
-    except (OSError, subprocess.TimeoutExpired):
-        return False
+    ok, _ = build_library(_LIB, sanitize=os.environ.get(SANITIZE_ENV) or None)
+    return ok
+
+
+#: path the cached _lib was loaded from (override authority check)
+_lib_path: str | None = None
 
 
 def load() -> ctypes.CDLL | None:
     """The shared library, building it if needed; None when unavailable."""
-    global _lib, _build_failed
+    global _lib, _lib_path, _build_failed
     with _lock:
-        if _lib is not None:
+        # The override is consulted BEFORE any cached state: an explicit
+        # artifact (sanitized fuzz child) must load exactly that .so or
+        # fail loudly, even when an earlier in-process load() already
+        # cached the default build or recorded a build failure — a silent
+        # fallback would turn the sanitizer gate into a no-op.
+        override = os.environ.get(LIB_OVERRIDE_ENV)
+        if _lib is not None and (not override or _lib_path == override):
             return _lib
-        if _build_failed:
-            return None
-        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
-            if not _build():
-                _build_failed = True
+        if override:
+            lib_path = override
+        else:
+            if _build_failed:
                 return None
-        lib = ctypes.CDLL(_LIB)
+            lib_path = _LIB
+            if (not os.path.exists(_LIB)
+                    or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+                if not _build():
+                    _build_failed = True
+                    return None
+        lib = ctypes.CDLL(lib_path)
         lib.fastx_parse.restype = ctypes.c_void_p
         lib.fastx_parse.argtypes = [ctypes.c_char_p]
         lib.fastx_error.restype = ctypes.c_char_p
@@ -90,7 +154,7 @@ def load() -> ctypes.CDLL | None:
         lib.fastx_next_chunk.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.fastx_close.restype = None
         lib.fastx_close.argtypes = [ctypes.c_void_p]
-        _lib = lib
+        _lib, _lib_path = lib, lib_path
         return _lib
 
 

@@ -9,7 +9,6 @@ analysis notebook parsers keep working against this framework's output.
 from __future__ import annotations
 
 import os
-from collections import defaultdict
 
 import numpy as np
 

@@ -24,10 +24,22 @@ empty files, pathological record sizes, junk splices, blank-line noise.
 
 Usage:
     python scripts/fuzz_ingest.py [--seeds 5] [--cases 200] [--start-seed 0]
+    python scripts/fuzz_ingest.py --sanitized [...]
+
+``--sanitized`` (ISSUE 4) replays the same differential corpus through an
+ASan/UBSan-instrumented build of the C++ parser: it compiles the library
+with ``-fsanitize=address,undefined``, then re-execs itself under
+``LD_PRELOAD=libasan.so`` with ``GRAFT_FASTX_LIB`` pointing the loader at
+the instrumented artifact. PR 3's campaign caught an out-of-bounds read
+only because the OOB happened to change parse output; under ASan the same
+bug dies on the first touch, with a stack. Any sanitizer report aborts
+the process (``abort_on_error=1`` / ``halt_on_error=1``) and fails the
+run.
 
 Exit status 1 on any divergence. Deterministic per (seed, case index).
-Tier-1 runs a 5-seed smoke (tests/test_fuzz_ingest.py); the >=1000-corpus
-campaign is the slow-marked test / a manual run of this script.
+Tier-1 runs a 5-seed smoke (tests/test_fuzz_ingest.py) plus a sanitized
+smoke (scripts/tier1.sh); the >=1000-corpus campaigns (plain and
+sanitized) are the slow-marked tests / manual runs of this script.
 """
 
 from __future__ import annotations
@@ -287,13 +299,73 @@ def run_campaign(seeds: list[int], cases: int, tmp_dir: str,
     return failures
 
 
+SANITIZE_FLAGS = "address,undefined"
+_SAN_CHILD_ENV = "_GRAFT_SAN_CHILD"
+
+
+def sanitized_lib_path() -> str:
+    """Cache path of the instrumented build (gitignored like libfastx.so)."""
+    return os.path.join(os.path.dirname(native._SRC), "libfastx_san.so")
+
+
+def reexec_sanitized(argv: list[str]) -> int:
+    """Build the ASan/UBSan parser and replay ``argv`` under the sanitizer.
+
+    The ASan runtime must be in the process before the instrumented .so
+    loads, and this Python is not ASan-linked — so the replay happens in a
+    re-exec'd child with ``LD_PRELOAD=libasan.so``. Returns the child's
+    exit status; build/toolchain unavailability is a skip (0) with a
+    notice, matching the plain fuzzer's no-toolchain behavior.
+    """
+    lib = sanitized_lib_path()
+    if (not os.path.exists(lib)
+            or os.path.getmtime(lib) < os.path.getmtime(native._SRC)):
+        ok, out = native.build_library(lib, sanitize=SANITIZE_FLAGS)
+        if not ok:
+            print(f"fuzz --sanitized: sanitized build failed/unavailable; "
+                  f"skipping ({out.strip()[:200]})", file=sys.stderr)
+            return 0
+    asan = native.asan_runtime_path()
+    if asan is None:
+        print("fuzz --sanitized: libasan.so not found; skipping", file=sys.stderr)
+        return 0
+    env = dict(
+        os.environ,
+        LD_PRELOAD=asan,
+        ASAN_OPTIONS="detect_leaks=0:abort_on_error=1",
+        UBSAN_OPTIONS="print_stacktrace=1:halt_on_error=1:abort_on_error=1",
+        **{native.LIB_OVERRIDE_ENV: lib, _SAN_CHILD_ENV: "1"},
+    )
+    # leak detection off on purpose: the interpreter + numpy leak-at-exit
+    # noise would drown real reports; the fuzzer's own allocations are
+    # handle-scoped (fastx_free) and OOB/UAF/UB all still abort
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), *argv], env=env,
+    )
+    if proc.returncode:
+        print(f"fuzz --sanitized: FAIL (child exit {proc.returncode}; a "
+              "sanitizer report aborts the replay)", file=sys.stderr)
+    return proc.returncode
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seeds", type=int, default=5, help="number of seeds")
     ap.add_argument("--start-seed", type=int, default=0)
     ap.add_argument("--cases", type=int, default=200,
                     help="mutated corpora per seed")
+    ap.add_argument("--sanitized", action="store_true",
+                    help="replay through the ASan/UBSan parser build")
     args = ap.parse_args(argv)
+    if args.sanitized and not os.environ.get(_SAN_CHILD_ENV):
+        child_argv = [a for a in (argv if argv is not None else sys.argv[1:])
+                      if a != "--sanitized"]
+        return reexec_sanitized(["--sanitized", *child_argv])
+    if args.sanitized:
+        print(f"fuzz: sanitized replay (fsanitize={SANITIZE_FLAGS}, "
+              f"lib={os.environ.get(native.LIB_OVERRIDE_ENV)})", file=sys.stderr)
     if not native.available():
         print("fuzz: native parser unavailable (no C++ toolchain); nothing "
               "to differ against", file=sys.stderr)

@@ -1,20 +1,46 @@
 #!/usr/bin/env bash
-# Tier-1 gate (ROADMAP.md "Tier-1 verify") + a fast chaos smoke + a seeded
-# ingest-fuzz smoke.
+# Tier-1 gate (ROADMAP.md "Tier-1 verify") + static analysis + a fast chaos
+# smoke + seeded ingest-fuzz smokes (plain and sanitized).
 #
 # Usage: scripts/tier1.sh [--no-chaos]
 #
-# Stage 1 is the exact ROADMAP tier-1 command: the full non-slow suite on
-# the CPU backend (this already includes the non-slow chaos scenarios and
-# the 5-seed fuzz smoke). Stage 2 re-runs ONLY the fast chaos subset
-# (-m 'chaos and not slow') so a robustness regression is named explicitly
-# in CI output instead of drowning in the full run; pass --no-chaos to
-# skip it. Stage 3 re-runs the differential ingest fuzzer standalone
-# (5 seeds; the >=1000-corpus campaign is the slow-marked test or
-# `python scripts/fuzz_ingest.py --cases 250`).
+# Stage 0 is static analysis: graftlint (tools/graftlint — repo-native AST
+# rules: jit hygiene, exception-guard safety, chaos-site and config-field
+# cross-checks) and ruff (curated pyflakes/bare-except set in
+# pyproject.toml; skipped with a notice when the container doesn't ship
+# ruff). Stage 1 is the exact ROADMAP tier-1 command: the full non-slow
+# suite on the CPU backend (this already includes the non-slow chaos
+# scenarios and the fuzz smokes). Stage 2 re-runs ONLY the fast chaos
+# subset (-m 'chaos and not slow') so a robustness regression is named
+# explicitly in CI output instead of drowning in the full run; pass
+# --no-chaos to skip it. Stage 3 re-runs the differential ingest fuzzer
+# standalone (5 seeds). Stage 4 replays a seeded corpus through the
+# ASan/UBSan parser build (scripts/fuzz_ingest.py --sanitized; the
+# >=1000-corpus campaigns are the slow-marked tests).
 
 set -o pipefail
 cd "$(dirname "$0")/.."
+
+echo "--- static analysis: graftlint ---"
+python -m tools.graftlint ont_tcrconsensus_tpu tests scripts tools
+lrc=$?
+if [ "$lrc" -ne 0 ]; then
+    echo "graftlint FAILED (rc=$lrc)" >&2
+    exit "$lrc"
+fi
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "--- static analysis: ruff ---"
+    ruff check ont_tcrconsensus_tpu tests scripts tools
+    rrc=$?
+    if [ "$rrc" -ne 0 ]; then
+        echo "ruff FAILED (rc=$rrc)" >&2
+        exit "$rrc"
+    fi
+else
+    echo "--- static analysis: ruff not installed; skipping (graftlint's" \
+         "unused-import/bare-except rules cover the overlap) ---"
+fi
 
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
@@ -44,5 +70,13 @@ frc=$?
 if [ "$frc" -ne 0 ]; then
     echo "ingest fuzz smoke FAILED (rc=$frc)" >&2
     exit "$frc"
+fi
+
+echo "--- sanitized fuzz smoke (ASan/UBSan parser, 3 seeds) ---"
+timeout -k 10 300 python scripts/fuzz_ingest.py --sanitized --seeds 3 --cases 20
+src=$?
+if [ "$src" -ne 0 ]; then
+    echo "sanitized fuzz smoke FAILED (rc=$src)" >&2
+    exit "$src"
 fi
 echo "tier-1 OK"

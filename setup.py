@@ -5,6 +5,13 @@ used to be a committed binary; now it compiles at install into the build
 tree (and so into wheels), best-effort: a host without g++/zlib still
 installs fine and the runtime loader's build-on-first-use + pure-Python
 fallback (io/native/__init__.py) take over.
+
+The build is warning-clean under ``-Wall -Wextra`` (enforced: the flags
+are always on). ``GRAFT_SANITIZE=address,undefined`` switches the build
+to an ASan/UBSan instrumented library (``-O1 -g -fsanitize=...
+-fno-omit-frame-pointer``) for the sanitized fuzz replay
+(``scripts/fuzz_ingest.py --sanitized``); see README "Static analysis &
+sanitized builds".
 """
 
 from __future__ import annotations
@@ -14,6 +21,20 @@ import subprocess
 
 from setuptools import setup
 from setuptools.command.build_py import build_py
+
+SANITIZE_ENV = "GRAFT_SANITIZE"
+WARN_FLAGS = ("-Wall", "-Wextra")
+
+
+def native_build_command(src: str, out: str, sanitize: str | None) -> list[str]:
+    """Mirror of io/native/__init__.py's build_command — setup.py cannot
+    import the package it is about to build, so the flags live here too
+    (tests/test_native.py pins the two in sync)."""
+    if sanitize:
+        opt = ["-O1", "-g", f"-fsanitize={sanitize}", "-fno-omit-frame-pointer"]
+    else:
+        opt = ["-O3"]
+    return ["g++", *opt, *WARN_FLAGS, "-shared", "-fPIC", src, "-lz", "-o", out]
 
 
 class BuildPyWithNativeParser(build_py):
@@ -26,10 +47,12 @@ class BuildPyWithNativeParser(build_py):
         out = os.path.join(native, "libfastx.so")
         if not os.path.exists(src):
             return
-        cmd = ["g++", "-O3", "-shared", "-fPIC", src, "-lz", "-o", out]
+        sanitize = os.environ.get(SANITIZE_ENV) or None
+        cmd = native_build_command(src, out, sanitize)
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=300)
-            print(f"built native fastx parser: {out}")
+            print(f"built native fastx parser: {out}"
+                  + (f" (sanitize={sanitize})" if sanitize else ""))
         except Exception as exc:  # noqa: BLE001 — any failure means fallback
             print(
                 "native fastx parser not built "

@@ -1,7 +1,5 @@
 """HBM budgeter: derived batch sizes are monotone, bounded, OOM-safe math."""
 
-import numpy as np
-
 from ont_tcrconsensus_tpu.parallel.budget import BudgetModel, detect_hbm_gb
 
 

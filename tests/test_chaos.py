@@ -24,7 +24,7 @@ import pytest
 from ont_tcrconsensus_tpu.io import fastx, simulator
 from ont_tcrconsensus_tpu.pipeline.config import RunConfig
 from ont_tcrconsensus_tpu.pipeline.run import run_with_config
-from ont_tcrconsensus_tpu.robustness import faults, retry, shutdown
+from ont_tcrconsensus_tpu.robustness import faults, shutdown
 
 pytestmark = pytest.mark.chaos
 

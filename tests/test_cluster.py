@@ -80,7 +80,7 @@ def test_umi_clustering_groups_molecules():
         label_of_mol.setdefault(mol, set()).add(int(lab))
     for mol, labs in label_of_mol.items():
         assert len(labs) == 1, f"molecule {mol} split into {labs}"
-    all_labels = [next(iter(l)) for l in label_of_mol.values()]
+    all_labels = [next(iter(labs)) for labs in label_of_mol.values()]
     assert len(set(all_labels)) == len(true_umis), "distinct molecules merged"
     assert out.num_clusters == len(true_umis)
 

@@ -1,0 +1,56 @@
+"""Chaos-site registry integrity (robustness/faults.py).
+
+Two directions, mirroring graftlint's chaos-site cross-check at runtime:
+arming an unknown site must fail fast (the registry's own error path),
+and the planted-literal set in the shipped source must equal
+``faults.KNOWN_SITES`` exactly — a typo'd plant or a stale registry entry
+is a chaos plan that silently tests nothing.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import pytest
+
+from ont_tcrconsensus_tpu.robustness import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def test_unknown_site_rejected_at_spec_construction():
+    with pytest.raises(ValueError, match="unknown chaos site"):
+        faults.FaultSpec(site="assign.dipsatch")
+
+
+def test_unknown_site_rejected_at_arm():
+    try:
+        with pytest.raises(ValueError, match="unknown chaos site"):
+            faults.arm([{"site": "no.such.site", "kind": "transient"}])
+    finally:
+        faults.disarm()
+
+
+def test_unknown_kind_and_bad_p_rejected():
+    with pytest.raises(ValueError, match="unknown chaos kind"):
+        faults.FaultSpec(site="assign.dispatch", kind="meteor")
+    with pytest.raises(ValueError, match="outside"):
+        faults.FaultSpec(site="assign.dispatch", p=1.5)
+
+
+def test_known_sites_match_planted_sites_exactly():
+    """Runtime twin of graftlint's chaos-unknown-site / chaos-unplanted-site
+    pair: collect every inject/mutate_input/tear_write literal in the
+    shipped package and require set equality with KNOWN_SITES."""
+    from tools.graftlint.core import Project
+    from tools.graftlint.rules.chaos_sites import planted_sites
+
+    project = Project([os.path.join(REPO, "ont_tcrconsensus_tpu")])
+    planted = planted_sites(project)
+    assert set(planted) == set(faults.KNOWN_SITES), (
+        f"planted-but-unknown: {sorted(set(planted) - faults.KNOWN_SITES)}; "
+        f"known-but-unplanted: {sorted(faults.KNOWN_SITES - set(planted))}"
+    )

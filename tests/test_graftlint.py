@@ -1,0 +1,374 @@
+"""graftlint (tools/graftlint): every rule family fires on a known-bad
+fixture snippet, suppressions work, and the shipped tree is clean.
+
+The fixture trees are written to tmp_path and linted through the same
+``run_paths`` entry point the tier-1 gate uses, so the cross-file rules
+(chaos sites, config fields) locate their anchors exactly as they do on
+the real tree. Two seeded regression fixtures reproduce shipped bugs:
+the PR 2 ``except Exception``-swallows-``Preempted`` shape (fixed by
+making ``Preempted`` a ``BaseException`` — the ``preempted-base`` rule
+pins that) and a misspelled chaos-site literal (the silent-dead-injection
+-point class the ``chaos-unknown-site`` rule exists for).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.graftlint import run_paths  # noqa: E402
+from tools.graftlint.core import main as graftlint_main  # noqa: E402
+
+
+def lint(tmp_path, files: dict[str, str]) -> list:
+    for rel, source in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(source)
+    return run_paths([str(tmp_path)])
+
+
+def rules_of(findings) -> set[str]:
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# rule family 1: jit-hygiene
+
+
+def test_jit_host_sync_fires(tmp_path):
+    findings = lint(tmp_path, {"bad.py": (
+        "import numpy as np\n"
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    y = np.asarray(x)\n"
+        "    z = float(x)\n"
+        "    return y, z, x.item()\n"
+    )})
+    assert rules_of(findings) == {"jit-host-sync"}
+    assert len(findings) == 3
+
+
+def test_jit_impure_and_tracer_branch_fire(tmp_path):
+    findings = lint(tmp_path, {"bad.py": (
+        "import time, random\n"
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    t = time.time()\n"
+        "    r = random.random()\n"
+        "    if x > 0:\n"
+        "        x = x + 1\n"
+        "    while x < 9:\n"
+        "        x = x + t + r\n"
+        "    return x\n"
+    )})
+    assert rules_of(findings) == {"jit-impure-call", "jit-tracer-branch"}
+    assert sum(f.rule == "jit-tracer-branch" for f in findings) == 2
+
+
+def test_jit_call_site_wrapping_detected(tmp_path):
+    """jax.jit(fn) / jit(shard_map(fn, ...)) mark fn as jitted too."""
+    findings = lint(tmp_path, {"bad.py": (
+        "import jax\n"
+        "def inner(a):\n"
+        "    return a.item()\n"
+        "wrapped = jax.jit(jax.vmap(inner))\n"
+    )})
+    assert rules_of(findings) == {"jit-host-sync"}
+
+
+def test_jit_static_and_shape_branches_are_clean(tmp_path):
+    """static_argnames params and .shape/len()-derived values are not
+    tracers; `is None` tests and directly-called nested helpers (the
+    sw_pallas pad_to shape) must not flag."""
+    findings = lint(tmp_path, {"ok.py": (
+        "import functools\n"
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@functools.partial(jax.jit, static_argnames=('n',))\n"
+        "def f(x, n, opt=None):\n"
+        "    if n > 4:\n"
+        "        x = x[:n]\n"
+        "    if opt is None:\n"
+        "        opt = 0\n"
+        "    def pad_to(y, m):\n"
+        "        if y.shape[0] == m:\n"
+        "            return y\n"
+        "        return jnp.zeros(m, y.dtype)\n"
+        "    for _ in range(len(x)):\n"
+        "        x = x + opt\n"
+        "    return pad_to(x, x.shape[0] + n)\n"
+    )})
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# rule family 2: exception-guard
+
+
+def test_pr2_regression_except_exception_swallows_preempted(tmp_path):
+    """Seeded regression: the PR 2 bug shape. Preempted subclassing
+    Exception makes every `except Exception` skip guard swallow a
+    preemption into 'library failed, skipped' — the rule pins the fix
+    (BaseException) at the class definition."""
+    findings = lint(tmp_path, {"bad.py": (
+        "class Preempted(Exception):\n"
+        "    pass\n"
+        "def guard(run_library, fastqs):\n"
+        "    for fq in fastqs:\n"
+        "        try:\n"
+        "            run_library(fq)\n"
+        "        except Exception as exc:\n"  # swallows the Preempted above
+        "            print('skipped', fq, exc)\n"
+    )})
+    assert rules_of(findings) == {"preempted-base"}
+
+
+def test_bare_except_and_broad_swallow_fire(tmp_path):
+    findings = lint(tmp_path, {"bad.py": (
+        "def f(work):\n"
+        "    try:\n"
+        "        work()\n"
+        "    except:\n"
+        "        pass\n"
+        "    try:\n"
+        "        work()\n"
+        "    except BaseException:\n"
+        "        return None\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Preempted:\n"
+        "        pass\n"
+    )})
+    assert rules_of(findings) == {
+        "bare-except", "broad-except-swallow", "preempted-swallow",
+    }
+
+
+def test_storing_or_reraising_the_exception_is_clean(tmp_path):
+    """The overlap-executor shapes: store for later re-raise, queue to the
+    consumer, bare re-raise — none may flag (and Preempted deriving from
+    BaseException is the fixed, correct form)."""
+    findings = lint(tmp_path, {"ok.py": (
+        "class Preempted(BaseException):\n"
+        "    pass\n"
+        "def f(work, q):\n"
+        "    exc_holder = []\n"
+        "    try:\n"
+        "        work()\n"
+        "    except BaseException as exc:\n"
+        "        exc_holder.append(exc)\n"
+        "    try:\n"
+        "        work()\n"
+        "    except BaseException as exc:\n"
+        "        q.put(exc)\n"
+        "    try:\n"
+        "        work()\n"
+        "    except BaseException:\n"
+        "        raise\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Preempted as p:\n"
+        "        stored = p\n"
+        "        raise stored\n"
+    )})
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# rule family 3: chaos-site cross-check
+
+_MINI_FAULTS = (
+    "KNOWN_SITES = frozenset({'assign.dispatch', 'polish.dispatch'})\n"
+    "def inject(site):\n"
+    "    pass\n"
+)
+
+
+def test_misspelled_chaos_site_fires(tmp_path):
+    """Seeded regression: a typo'd plant literal is a silently dead
+    injection point — arming the real site never fires."""
+    findings = lint(tmp_path, {
+        "faults.py": _MINI_FAULTS,
+        "plant.py": (
+            "import faults\n"
+            "def go():\n"
+            "    faults.inject('assign.dispatch')\n"
+            "    faults.inject('polish.dipsatch')\n"  # misspelled
+        ),
+    })
+    assert rules_of(findings) == {"chaos-unknown-site", "chaos-unplanted-site"}
+    unknown = [f for f in findings if f.rule == "chaos-unknown-site"]
+    assert len(unknown) == 1 and "polish.dipsatch" in unknown[0].message
+    # the typo also leaves the REAL site unplanted: both directions report
+    unplanted = [f for f in findings if f.rule == "chaos-unplanted-site"]
+    assert len(unplanted) == 1 and "polish.dispatch" in unplanted[0].message
+
+
+def test_chaos_parity_is_clean(tmp_path):
+    findings = lint(tmp_path, {
+        "faults.py": _MINI_FAULTS,
+        "plant.py": (
+            "import faults\n"
+            "def go():\n"
+            "    faults.inject('assign.dispatch')\n"
+            "    faults.mutate_input('polish.dispatch', 'x')\n"
+        ),
+    })
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# rule family 4: config-field cross-check
+
+_MINI_CONFIG = (
+    "import dataclasses\n"
+    "@dataclasses.dataclass\n"
+    "class RunConfig:\n"
+    "    resume: bool = False\n"
+    "    read_batch_size = None\n"
+    "    @property\n"
+    "    def cluster_identity(self):\n"
+    "        return 0.93\n"
+    "    def validate(self):\n"
+    "        pass\n"
+)
+
+
+def test_config_field_typo_fires(tmp_path):
+    findings = lint(tmp_path, {
+        "config.py": _MINI_CONFIG,
+        "use.py": (
+            "from config import RunConfig\n"
+            "def run(cfg: RunConfig):\n"
+            "    return cfg.reusme\n"  # typo'd field
+            "def load(d):\n"
+            "    cfg = RunConfig.from_dict(d)\n"
+            "    return cfg.read_batchsize\n"  # typo'd field
+        ),
+    })
+    assert rules_of(findings) == {"config-unknown-field"}
+    assert len(findings) == 2
+
+
+def test_config_fields_properties_methods_are_clean(tmp_path):
+    findings = lint(tmp_path, {
+        "config.py": _MINI_CONFIG,
+        "use.py": (
+            "import dataclasses\n"
+            "from config import RunConfig\n"
+            "def run(cfg: RunConfig, untyped):\n"
+            "    cfg2 = dataclasses.replace(cfg, resume=True)\n"
+            "    ok = (cfg.resume, cfg.read_batch_size, cfg.cluster_identity,\n"
+            "          cfg2.validate())\n"
+            "    return ok, untyped.whatever\n"  # untyped: out of scope
+        ),
+    })
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# unused-import + suppressions + output plumbing
+
+
+def test_unused_import_fires_and_noqa_exempts(tmp_path):
+    findings = lint(tmp_path, {"mod.py": (
+        "import os\n"
+        "import json  # noqa: F401  (re-exported)\n"
+        "import sys\n"
+        "print(sys.argv)\n"
+    )})
+    assert [f.rule for f in findings] == ["unused-import"]
+    assert "`os`" in findings[0].message
+
+
+def test_init_py_exempt_from_unused_import(tmp_path):
+    findings = lint(tmp_path, {"pkg/__init__.py": "import os\n"})
+    assert findings == []
+
+
+def test_inline_and_file_suppressions(tmp_path):
+    findings = lint(tmp_path, {
+        "inline.py": (
+            "import os  # graftlint: disable=unused-import\n"
+        ),
+        "whole_file.py": (
+            "# graftlint: disable-file=bare-except\n"
+            "def f(work):\n"
+            "    try:\n"
+            "        work()\n"
+            "    except:\n"
+            "        pass\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_parse_error_reported(tmp_path):
+    findings = lint(tmp_path, {"broken.py": "def f(:\n"})
+    assert [f.rule for f in findings] == ["parse-error"]
+
+
+def test_nul_byte_reported_as_parse_error(tmp_path):
+    """ast.parse raises bare ValueError (not SyntaxError) on NUL bytes;
+    a corrupted file must become a finding, not a linter traceback."""
+    (tmp_path / "nul.py").write_bytes(b"x = 1\n\x00\n")
+    findings = run_paths([str(tmp_path)])
+    assert [f.rule for f in findings] == ["parse-error"]
+
+
+def test_noqa_with_unrelated_code_does_not_exempt(tmp_path):
+    """`# noqa: E501` on an unused import must still flag; only a bare
+    noqa or an F401 code list is a re-export marker."""
+    findings = lint(tmp_path, {"mod.py": (
+        "import os  # noqa: E501\n"
+        "import json  # noqa\n"
+        "import abc  # noqa: E501, F401\n"
+    )})
+    assert [f.rule for f in findings] == ["unused-import"]
+    assert "`os`" in findings[0].message
+
+
+def test_sort_key_lambda_does_not_leak_taint(tmp_path):
+    """A lambda's params are only traced INSIDE the lambda: a sort-key
+    lambda reusing a static name must not poison later branches on it."""
+    findings = lint(tmp_path, {"ok.py": (
+        "import functools\n"
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@functools.partial(jax.jit, static_argnames=('n',))\n"
+        "def f(x, n):\n"
+        "    order = sorted(range(3), key=lambda n: -n)\n"
+        "    if n > 4:\n"
+        "        return jnp.sum(x[:n]) + order[0]\n"
+        "    return jnp.sum(x)\n"
+    )})
+    assert findings == []
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    (tmp_path / "bad.py").write_text("import os\n")
+    assert graftlint_main([str(tmp_path)]) == 1
+    assert graftlint_main(["--json", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert '"unused-import"' in out and '"count": 1' in out
+    (tmp_path / "bad.py").write_text("import os\nprint(os.sep)\n")
+    assert graftlint_main([str(tmp_path)]) == 0
+    assert graftlint_main([str(tmp_path / "nope")]) == 2
+
+
+# ---------------------------------------------------------------------------
+# the shipped tree is clean (acceptance)
+
+
+def test_shipped_tree_is_clean():
+    paths = [os.path.join(REPO, p)
+             for p in ("ont_tcrconsensus_tpu", "tests", "scripts", "tools")]
+    findings = run_paths(paths)
+    assert findings == [], "\n".join(f.format() for f in findings)

@@ -1,6 +1,5 @@
 """IO layer: fastx round-trips, bucketing, layout, config."""
 
-import numpy as np
 import pytest
 
 from ont_tcrconsensus_tpu.io import bucketing, fastx, layout

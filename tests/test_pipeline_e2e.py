@@ -7,9 +7,7 @@ per region is exactly its molecule count.
 """
 
 import json
-import os
 
-import numpy as np
 import pytest
 
 from ont_tcrconsensus_tpu.io import fastx, simulator

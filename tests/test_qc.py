@@ -297,7 +297,7 @@ def test_error_profile_device_matches_batch():
 
 
 def test_stats_artifacts(tmp_path):
-    from ont_tcrconsensus_tpu.pipeline.assign import AlignStats, LengthStats
+    from ont_tcrconsensus_tpu.pipeline.assign import AlignStats
     from ont_tcrconsensus_tpu.qc import artifacts
     import numpy as np
 

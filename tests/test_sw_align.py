@@ -1,7 +1,6 @@
 """Banded SW kernel vs full-DP numpy oracle, plus amplicon-geometry cases."""
 
 import numpy as np
-import pytest
 
 from ont_tcrconsensus_tpu.io import simulator
 from ont_tcrconsensus_tpu.ops import encode, sw_align

@@ -1,0 +1,1 @@
+"""First-party developer tooling (not shipped in the wheel)."""

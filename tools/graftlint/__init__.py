@@ -1,0 +1,23 @@
+"""graftlint — repo-native static analysis for the jax_graft codebase.
+
+Generic linters know nothing about this repo's proven bug classes: host
+syncs inside ``@jax.jit`` functions, ``except`` guards that can swallow
+:class:`~ont_tcrconsensus_tpu.robustness.shutdown.Preempted`, chaos-site
+literals that drift from ``faults.KNOWN_SITES``, and ``cfg.<typo>``
+accesses that only fail at runtime on rare paths. graftlint encodes each
+of those as an AST rule and gates them in ``scripts/tier1.sh``.
+
+Usage::
+
+    python -m tools.graftlint ont_tcrconsensus_tpu tests scripts
+    python -m tools.graftlint --json path/to/file.py
+    python -m tools.graftlint --list-rules
+
+Suppress a finding inline with ``# graftlint: disable=<rule-id>`` on the
+offending line (comma-separate several ids, or ``all``); suppress a rule
+for a whole file with ``# graftlint: disable-file=<rule-id>`` on any line.
+Exit status: 0 clean, 1 findings, 2 usage/internal error.
+"""
+
+from tools.graftlint.core import Finding, Project, run_paths  # noqa: F401
+from tools.graftlint.rules import RULE_CATALOGUE  # noqa: F401

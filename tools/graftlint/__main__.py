@@ -1,0 +1,8 @@
+"""``python -m tools.graftlint`` entry point."""
+
+import sys
+
+from tools.graftlint.core import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,74 @@
+"""Small shared AST helpers used by several rules."""
+
+from __future__ import annotations
+
+import ast
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'jax.jit' for Attribute/Name chains; None for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ImportMap:
+    """Per-file import aliasing: which local names refer to which modules.
+
+    ``modules`` maps a local name to the dotted module it binds
+    (``import numpy as np`` -> {'np': 'numpy'}); ``from_imports`` maps a
+    local name to 'module.attr' (``from time import perf_counter`` ->
+    {'perf_counter': 'time.perf_counter'}).
+    """
+
+    def __init__(self, tree: ast.AST):
+        self.modules: dict[str, str] = {}
+        self.from_imports: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    self.modules[local] = alias.name if alias.asname else local
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    self.from_imports[local] = f"{node.module}.{alias.name}"
+
+    def resolve_call_target(self, func: ast.AST) -> str | None:
+        """Fully-qualified dotted target of a call's ``func`` node, through
+        the file's import aliases ('np.asarray' -> 'numpy.asarray')."""
+        dotted = dotted_name(func)
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        if head in self.modules:
+            base = self.modules[head]
+            return f"{base}.{rest}" if rest else base
+        if head in self.from_imports:
+            base = self.from_imports[head]
+            return f"{base}.{rest}" if rest else base
+        return dotted
+
+
+def func_defs_by_name(tree: ast.AST) -> dict[str, list[ast.FunctionDef]]:
+    """Every (possibly nested) function definition in the module, by name."""
+    out: dict[str, list[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, []).append(node)
+    return out
+
+
+def str_constants(node: ast.AST) -> list[str]:
+    """All string literals anywhere under ``node``."""
+    return [
+        n.value for n in ast.walk(node)
+        if isinstance(n, ast.Constant) and isinstance(n.value, str)
+    ]

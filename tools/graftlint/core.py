@@ -1,0 +1,186 @@
+"""Visitor core: file collection, parsing, suppressions, the runner.
+
+Rules are project-scoped: each rule module exposes ``RULES`` (id ->
+one-line description) and ``check(project)`` yielding :class:`Finding`s.
+Cross-file rules (chaos sites, config fields) see every scanned file
+through :class:`Project`; per-file rules just iterate ``project.files``.
+Rules locate their anchors (``class RunConfig``, ``KNOWN_SITES``) inside
+the scanned set itself, so fixture trees in tests exercise the identical
+code path as the shipped tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import sys
+import tokenize
+
+_SKIP_DIRS = {
+    "__pycache__", ".git", "build", "dist", ".pytest_cache", ".scratch",
+    ".jax_cache", ".jax_kernel_cache", "node_modules",
+}
+
+_MAGIC = "graftlint:"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class FileCtx:
+    """One parsed source file + its suppression comments."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)  # SyntaxError propagates
+        # line -> set of rule ids disabled on that line; "all" disables all
+        self.line_disables: dict[int, set[str]] = {}
+        self.file_disables: set[str] = set()
+        self._scan_comments()
+
+    def _scan_comments(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            comments = [t for t in tokens if t.type == tokenize.COMMENT]
+        except (tokenize.TokenError, IndentationError):  # pragma: no cover
+            return
+        for tok in comments:
+            text = tok.string.lstrip("#").strip()
+            if not text.startswith(_MAGIC):
+                continue
+            directive = text[len(_MAGIC):].strip()
+            for part in directive.split():
+                if part.startswith("disable-file="):
+                    self.file_disables.update(
+                        r.strip() for r in part[len("disable-file="):].split(",") if r.strip()
+                    )
+                elif part.startswith("disable="):
+                    ids = {r.strip() for r in part[len("disable="):].split(",") if r.strip()}
+                    self.line_disables.setdefault(tok.start[0], set()).update(ids)
+
+    def suppressed(self, finding: Finding) -> bool:
+        if finding.rule in self.file_disables or "all" in self.file_disables:
+            return True
+        ids = self.line_disables.get(finding.line, ())
+        return finding.rule in ids or "all" in ids
+
+
+class Project:
+    """Every scanned file, plus the findings for unparseable ones."""
+
+    def __init__(self, paths: list[str]):
+        self.files: list[FileCtx] = []
+        self.parse_findings: list[Finding] = []
+        for path in collect_py_files(paths):
+            try:
+                with open(path, encoding="utf-8", errors="replace") as fh:
+                    source = fh.read()
+                self.files.append(FileCtx(path, source))
+            except SyntaxError as exc:
+                self.parse_findings.append(Finding(
+                    path, exc.lineno or 1, (exc.offset or 1) - 1,
+                    "parse-error", f"file does not parse: {exc.msg}",
+                ))
+            except ValueError as exc:
+                # ast.parse raises bare ValueError for NUL bytes in source
+                self.parse_findings.append(Finding(
+                    path, 1, 0, "parse-error", f"file does not parse: {exc}",
+                ))
+
+    def file_named(self, basename: str) -> list[FileCtx]:
+        return [f for f in self.files if os.path.basename(f.path) == basename]
+
+
+def collect_py_files(paths: list[str]) -> list[str]:
+    out: set[str] = set()
+    for path in paths:
+        if os.path.isfile(path):
+            out.add(path)
+            continue
+        for root, dirs, names in os.walk(path):
+            dirs[:] = sorted(
+                d for d in dirs
+                if d not in _SKIP_DIRS and not d.endswith(".egg-info")
+            )
+            for name in names:
+                if name.endswith(".py"):
+                    out.add(os.path.join(root, name))
+    return sorted(out)
+
+
+def run_paths(paths: list[str]) -> list[Finding]:
+    """Lint ``paths`` with every registered rule; returns surviving findings
+    sorted by location (suppressions already applied)."""
+    from tools.graftlint import rules
+
+    project = Project(paths)
+    findings = list(project.parse_findings)  # parse errors: not suppressible
+    by_path = {f.path: f for f in project.files}
+    for check in rules.CHECKS:
+        for finding in check(project):
+            ctx = by_path.get(finding.path)
+            if ctx is not None and ctx.suppressed(finding):
+                continue
+            findings.append(finding)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    from tools.graftlint import rules
+
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="repo-native static analysis (see tools/graftlint/__init__.py)",
+    )
+    ap.add_argument("paths", nargs="*", help="files or directories to lint")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id, desc in sorted(rules.RULE_CATALOGUE.items()):
+            print(f"{rule_id:24s} {desc}")
+        return 0
+    if not args.paths:
+        ap.print_usage(sys.stderr)
+        print("graftlint: no paths given", file=sys.stderr)
+        return 2
+    for path in args.paths:
+        if not os.path.exists(path):
+            print(f"graftlint: no such path: {path}", file=sys.stderr)
+            return 2
+
+    findings = run_paths(args.paths)
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "count": len(findings),
+        }, indent=2))
+    else:
+        for finding in findings:
+            print(finding.format())
+        if findings:
+            print(f"graftlint: {len(findings)} finding(s)", file=sys.stderr)
+    return 1 if findings else 0

@@ -1,0 +1,21 @@
+"""Rule registry: every rule module's ``check`` + the combined catalogue."""
+
+from __future__ import annotations
+
+from tools.graftlint.rules import (
+    chaos_sites,
+    config_fields,
+    exception_guard,
+    imports,
+    jit_hygiene,
+)
+
+_MODULES = (jit_hygiene, exception_guard, chaos_sites, config_fields, imports)
+
+CHECKS = tuple(m.check for m in _MODULES)
+
+RULE_CATALOGUE: dict[str, str] = {
+    "parse-error": "file does not parse (not suppressible)",
+}
+for _m in _MODULES:
+    RULE_CATALOGUE.update(_m.RULES)

@@ -1,0 +1,97 @@
+"""chaos-site cross-check: planted literals vs ``faults.KNOWN_SITES``.
+
+The chaos registry fails fast on unknown sites when ARMING a plan, but a
+typo in a *planted* ``faults.inject("...")`` literal is silent forever:
+the site never matches any spec and the injection point is dead. The
+inverse drift — a ``KNOWN_SITES`` entry whose plant was refactored away —
+leaves chaos plans that "pass" without testing anything. Both directions
+are cross-file properties, checked here:
+
+- ``chaos-unknown-site``   — an ``inject``/``mutate_input``/``tear_write``
+  site literal that is not in ``KNOWN_SITES``;
+- ``chaos-unplanted-site`` — a ``KNOWN_SITES`` entry never planted in the
+  scanned tree (reported at the entry's own line in faults.py).
+
+``KNOWN_SITES`` is read from the scanned files themselves (the
+``KNOWN_SITES = frozenset({...})`` assignment), so fixture trees exercise
+the same path; with no definition in scope both checks no-op.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.core import FileCtx, Finding, Project
+
+RULES = {
+    "chaos-unknown-site": "faults.inject/mutate_input/tear_write site literal "
+                          "not in faults.KNOWN_SITES (dead injection point)",
+    "chaos-unplanted-site": "KNOWN_SITES entry not planted at any injection "
+                            "point in the scanned tree",
+}
+
+_PLANT_FUNCS = {"inject", "mutate_input", "tear_write"}
+
+
+def known_sites(project: Project) -> dict[str, tuple[str, int]]:
+    """{site: (path, line)} from every ``KNOWN_SITES = frozenset(...)`` /
+    set-literal assignment in the scanned files."""
+    sites: dict[str, tuple[str, int]] = {}
+    for ctx in project.files:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "KNOWN_SITES"
+                for t in node.targets
+            )):
+                continue
+            for const in ast.walk(node.value):
+                if isinstance(const, ast.Constant) and isinstance(const.value, str):
+                    sites[const.value] = (ctx.path, const.lineno)
+    return sites
+
+
+def _plant_calls(ctx: FileCtx) -> Iterator[tuple[ast.Call, str]]:
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None
+        )
+        if name not in _PLANT_FUNCS:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            yield node, first.value
+
+
+def planted_sites(project: Project) -> dict[str, list[tuple[str, int]]]:
+    """{site literal: [(path, line), ...]} for every plant call in scope."""
+    plants: dict[str, list[tuple[str, int]]] = {}
+    for ctx in project.files:
+        for node, site in _plant_calls(ctx):
+            plants.setdefault(site, []).append((ctx.path, node.lineno))
+    return plants
+
+
+def check(project: Project) -> Iterator[Finding]:
+    known = known_sites(project)
+    if not known:
+        return  # no faults registry in the scanned set: nothing to check
+    plants = planted_sites(project)
+    for ctx in project.files:
+        for node, site in _plant_calls(ctx):
+            if site not in known:
+                yield Finding(
+                    ctx.path, node.lineno, node.col_offset, "chaos-unknown-site",
+                    f"site {site!r} is not in faults.KNOWN_SITES — this "
+                    "injection point can never fire (typo?)",
+                )
+    for site, (path, line) in sorted(known.items()):
+        if site not in plants:
+            yield Finding(
+                path, line, 0, "chaos-unplanted-site",
+                f"KNOWN_SITES entry {site!r} is planted nowhere in the "
+                "scanned tree — chaos plans arming it test nothing",
+            )

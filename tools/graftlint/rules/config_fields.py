@@ -1,0 +1,155 @@
+"""config-field cross-check: every ``cfg.<attr>`` must exist on RunConfig.
+
+``RunConfig.from_dict`` rejects unknown *keys*, but an attribute typo on
+the read side (``cfg.read_batchsize``) is an AttributeError that only
+fires when the code path runs — on rare paths, that is production. This
+rule resolves attribute accesses on provably-RunConfig values against the
+fields, properties and methods declared on the class.
+
+A name is "provably RunConfig" when it is a parameter annotated
+``RunConfig`` (string annotations included), assigned from
+``RunConfig(...)`` / ``RunConfig.from_dict(...)`` / ``from_json(...)``,
+or assigned from ``dataclasses.replace(<runconfig>, ...)``. Anything
+else (untyped test helpers, dicts named cfg) is out of scope — the rule
+trades recall for zero false positives.
+
+The class definition is located inside the scanned files (any
+``class RunConfig``), so fixtures exercise the same path; with no
+definition in scope the rule no-ops.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.astutil import dotted_name
+from tools.graftlint.core import Finding, Project
+
+RULES = {
+    "config-unknown-field": "attribute access on a RunConfig value that "
+                            "matches no declared field/property/method",
+}
+
+_CLASS_NAME = "RunConfig"
+_CTORS = {"RunConfig", "RunConfig.from_dict", "RunConfig.from_json"}
+
+
+def _allowed_attrs(project: Project) -> set[str] | None:
+    """Declared attributes of every ``class RunConfig`` in scope (fields,
+    class vars, methods, properties); None when no class is found."""
+    allowed: set[str] | None = None
+    for ctx in project.files:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.ClassDef) and node.name == _CLASS_NAME):
+                continue
+            allowed = set() if allowed is None else allowed
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                    allowed.add(stmt.target.id)
+                elif isinstance(stmt, ast.Assign):
+                    allowed.update(
+                        t.id for t in stmt.targets if isinstance(t, ast.Name)
+                    )
+                elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    allowed.add(stmt.name)
+    return allowed
+
+
+def _is_runconfig_annotation(node: ast.AST | None) -> bool:
+    if node is None:
+        return False
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.split(".")[-1] == _CLASS_NAME
+    name = dotted_name(node)
+    if name is not None and name.split(".")[-1] == _CLASS_NAME:
+        return True
+    # Optional[RunConfig] / RunConfig | None
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+        return _is_runconfig_annotation(node.left) or _is_runconfig_annotation(node.right)
+    if isinstance(node, ast.Subscript):
+        return _is_runconfig_annotation(node.slice)
+    return False
+
+
+class _ScopeChecker(ast.NodeVisitor):
+    """One function (or module) scope: track RunConfig-typed names, check
+    attribute accesses on them."""
+
+    def __init__(self, ctx, allowed: set[str], findings: list[Finding]):
+        self.ctx = ctx
+        self.allowed = allowed
+        self.findings = findings
+        self.typed: set[str] = set()
+
+    def _is_runconfig_value(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.typed
+        if isinstance(node, ast.Call):
+            target = dotted_name(node.func)
+            if target in _CTORS:
+                return True
+            if target in ("dataclasses.replace", "replace") and node.args:
+                return self._is_runconfig_value(node.args[0])
+        return False
+
+    def _bind(self, target: ast.AST, is_cfg: bool) -> None:
+        if isinstance(target, ast.Name):
+            (self.typed.add if is_cfg else self.typed.discard)(target.id)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        is_cfg = self._is_runconfig_value(node.value)
+        for target in node.targets:
+            self._bind(target, is_cfg)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if _is_runconfig_annotation(node.annotation):
+            self._bind(node.target, True)
+        elif node.value is not None:
+            self._bind(node.target, self._is_runconfig_value(node.value))
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if self._is_runconfig_value(node.value) and node.attr not in self.allowed:
+            if not (node.attr.startswith("__") and node.attr.endswith("__")):
+                self.findings.append(Finding(
+                    self.ctx.path, node.lineno, node.col_offset,
+                    "config-unknown-field",
+                    f"RunConfig has no field `{node.attr}` — this is an "
+                    "AttributeError on whatever rare path reaches it",
+                ))
+        self.generic_visit(node)
+
+    # nested functions get their own scope (fresh typed-name set seeded
+    # from annotated params; outer locals are not tracked across scopes)
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        _check_function(self.ctx, node, self.allowed, self.findings)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        if node.name == _CLASS_NAME:
+            return  # the class's own body accesses self.<field> dynamically
+        self.generic_visit(node)
+
+
+def _check_function(ctx, fn, allowed: set[str], findings: list[Finding]) -> None:
+    checker = _ScopeChecker(ctx, allowed, findings)
+    for arg in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs:
+        if _is_runconfig_annotation(arg.annotation):
+            checker.typed.add(arg.arg)
+    for stmt in fn.body:
+        checker.visit(stmt)
+
+
+def check(project: Project) -> Iterator[Finding]:
+    allowed = _allowed_attrs(project)
+    if not allowed:
+        return
+    for ctx in project.files:
+        findings: list[Finding] = []
+        checker = _ScopeChecker(ctx, allowed, findings)
+        for stmt in ctx.tree.body:
+            checker.visit(stmt)
+        yield from findings

@@ -1,0 +1,119 @@
+"""exception-guard: except clauses that can swallow a shutdown request.
+
+PR 2's root-cause bug: the per-library ``except Exception`` skip guard
+silently swallowed the shutdown coordinator's ``Preempted`` into "library
+failed, skipped" — the fix was deriving ``Preempted`` from
+``BaseException`` so the broad guard structurally cannot catch it. These
+rules pin that invariant and its neighbors:
+
+- ``bare-except``          — ``except:`` catches BaseException, so it
+  swallows ``Preempted`` (and KeyboardInterrupt); write
+  ``except Exception`` for degradation guards;
+- ``broad-except-swallow`` — ``except BaseException`` whose handler
+  neither re-raises nor lets the exception escape (stored/queued/passed
+  on): the caught preemption dies there;
+- ``preempted-base``       — a class named ``Preempted`` must derive
+  directly from ``BaseException``; subclassing ``Exception`` reintroduces
+  the PR 2 bug at every ``except Exception`` guard in the tree;
+- ``preempted-swallow``    — an except clause naming ``Preempted`` whose
+  handler neither re-raises nor stores it for re-raise.
+
+"Escapes" recognized: a ``raise`` anywhere in the handler, or the caught
+name used in an assignment / call argument / return (the overlap executor
+stores worker exceptions and re-raises them at commit on the main thread).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.core import Finding, Project
+
+RULES = {
+    "bare-except": "bare `except:` swallows Preempted/KeyboardInterrupt; "
+                   "catch Exception (or narrower)",
+    "broad-except-swallow": "`except BaseException` that neither re-raises "
+                            "nor lets the exception escape",
+    "preempted-base": "class Preempted must derive directly from "
+                      "BaseException, not Exception",
+    "preempted-swallow": "except clause catching Preempted without "
+                         "re-raising or storing it",
+}
+
+
+def _type_mentions(type_node: ast.AST | None, name: str) -> bool:
+    if type_node is None:
+        return False
+    for node in ast.walk(type_node):
+        if isinstance(node, ast.Name) and node.id == name:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == name:
+            return True
+    return False
+
+
+def _handler_lets_exception_escape(handler: ast.ExceptHandler) -> bool:
+    caught = handler.name
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if caught is None:
+            continue
+        if isinstance(node, ast.Assign) and any(
+            isinstance(n, ast.Name) and n.id == caught
+            for n in ast.walk(node.value)
+        ):
+            return True
+        if isinstance(node, ast.Return) and node.value is not None and any(
+            isinstance(n, ast.Name) and n.id == caught
+            for n in ast.walk(node.value)
+        ):
+            return True
+        if isinstance(node, ast.Call) and any(
+            isinstance(n, ast.Name) and n.id == caught
+            for a in list(node.args) + [k.value for k in node.keywords]
+            for n in ast.walk(a)
+        ):
+            return True
+    return False
+
+
+def check(project: Project) -> Iterator[Finding]:
+    for ctx in project.files:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "Preempted":
+                if not any(
+                    (isinstance(b, ast.Name) and b.id == "BaseException")
+                    or (isinstance(b, ast.Attribute) and b.attr == "BaseException")
+                    for b in node.bases
+                ):
+                    yield Finding(
+                        ctx.path, node.lineno, node.col_offset, "preempted-base",
+                        "class Preempted must subclass BaseException directly "
+                        "so `except Exception` degradation guards can never "
+                        "swallow a preemption",
+                    )
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield Finding(
+                    ctx.path, node.lineno, node.col_offset, "bare-except",
+                    "bare `except:` catches BaseException and swallows "
+                    "Preempted/KeyboardInterrupt; catch Exception or narrower",
+                )
+                continue
+            escapes = _handler_lets_exception_escape(node)
+            if _type_mentions(node.type, "BaseException") and not escapes:
+                yield Finding(
+                    ctx.path, node.lineno, node.col_offset,
+                    "broad-except-swallow",
+                    "`except BaseException` without re-raise/escape swallows "
+                    "Preempted; re-raise, store it, or catch Exception",
+                )
+            if _type_mentions(node.type, "Preempted") and not escapes:
+                yield Finding(
+                    ctx.path, node.lineno, node.col_offset, "preempted-swallow",
+                    "Preempted caught but neither re-raised nor stored; the "
+                    "shutdown request dies here",
+                )

@@ -1,0 +1,90 @@
+"""unused-import: pyflakes' highest-value check, natively.
+
+The container may not ship ruff (tier1.sh skips that stage when absent),
+so the one ruff rule that regularly catches real drift — an import kept
+after its last use was refactored away — is enforced here too. A name
+counts as used when it appears as a load anywhere in the file (including
+inside annotations and f-strings), when it is re-exported via
+``__all__``, or when the import is a documented side-effect import
+(suppress with ``# graftlint: disable=unused-import``).
+
+``__init__.py`` files are exempt: their imports ARE the public API, and
+an import statement carrying ``# noqa`` (bare or ``F401``) is honored as
+a re-export marker for ruff/pyflakes interop.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterator
+
+from tools.graftlint.core import Finding, Project
+
+RULES = {
+    "unused-import": "imported name is never used in the file",
+}
+
+_NOQA_RE = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z]+[0-9]+(?:[,\s]+[A-Z]+[0-9]+)*))?",
+                      re.IGNORECASE)
+
+
+def _noqa_exempts(line: str) -> bool:
+    """True for a bare ``# noqa`` or one whose code list includes F401 —
+    a ``# noqa: E501`` must NOT exempt unused-import."""
+    m = _NOQA_RE.search(line)
+    if m is None:
+        return False
+    codes = m.group("codes")
+    return codes is None or "F401" in codes.upper()
+
+
+def _used_names(tree: ast.AST) -> set[str]:
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            base = node
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name):
+                used.add(base.id)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            # quoted annotations ("RunConfig") and __all__ entries
+            used.add(node.value.split(".")[0])
+    return used
+
+
+def check(project: Project) -> Iterator[Finding]:
+    for ctx in project.files:
+        if os.path.basename(ctx.path) == "__init__.py":
+            continue
+        used = _used_names(ctx.tree)
+        lines = ctx.source.splitlines()
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, (ast.Import, ast.ImportFrom))
+                    and node.lineno <= len(lines)
+                    and _noqa_exempts(lines[node.lineno - 1])):
+                continue
+            if isinstance(node, ast.Import):
+                bindings = [
+                    (alias, alias.asname or alias.name.split(".")[0])
+                    for alias in node.names
+                ]
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                bindings = [
+                    (alias, alias.asname or alias.name)
+                    for alias in node.names if alias.name != "*"
+                ]
+            else:
+                continue
+            for alias, local in bindings:
+                if local not in used:
+                    yield Finding(
+                        ctx.path, node.lineno, node.col_offset, "unused-import",
+                        f"`{alias.name}` is imported but never used",
+                    )

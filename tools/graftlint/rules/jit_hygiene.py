@@ -1,0 +1,341 @@
+"""jit-hygiene: host-sync / impurity / retrace hazards inside jitted code.
+
+A ``@jax.jit`` function's non-static parameters are tracers. Touching one
+with host-side machinery either crashes at trace time on a rare path or —
+worse — silently forces a device sync / retrace on every call. Three
+hazards, each a rule:
+
+- ``jit-host-sync``   — ``np.*`` calls, ``float()/int()/bool()``,
+  ``.item()/.tolist()`` applied to a traced value;
+- ``jit-impure-call`` — ``time.*`` / ``random.*`` / ``np.random.*``
+  calls anywhere in a jitted body (impure: baked in at trace time, then
+  frozen — the classic "why is my jitted timestamp constant" bug);
+- ``jit-tracer-branch`` — Python ``if``/``while``/``assert``/ternary (or a
+  ``for`` loop's iterable) on a traced value: a concretization error at
+  trace time, or an unrolled retrace bomb.
+
+Taint model: non-static parameters of a jitted function (and of every
+function nested inside it — ``lax.scan``/``vmap`` bodies) are tainted;
+assignments propagate taint through expressions. Reading ``.shape`` /
+``.ndim`` / ``.dtype`` / ``.size`` or calling ``len()`` on a tracer
+yields a static Python value, so those strip taint, as do ``is None``
+comparisons. Jitted functions are found both by decorator
+(``@jax.jit``, ``@partial(jax.jit, static_argnames=...)``) and by call
+site (``jax.jit(fn)``, ``jax.jit(shard_map(fn, ...))`` — any local
+function named inside the wrapped expression).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.astutil import ImportMap, dotted_name, func_defs_by_name
+from tools.graftlint.core import FileCtx, Finding, Project
+
+RULES = {
+    "jit-host-sync": "numpy/float/int/bool/.item() applied to a traced value "
+                     "inside a jitted function",
+    "jit-impure-call": "time.*/random.* call inside a jitted function "
+                       "(baked in at trace time)",
+    "jit-tracer-branch": "Python control flow on a traced value inside a "
+                         "jitted function",
+}
+
+# attribute reads that return STATIC Python values even on a tracer
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "weak_type"}
+# builtins whose result is static (and which are safe on tracers)
+_STATIC_CALLS = {"len", "isinstance", "type", "getattr", "hasattr", "id"}
+# builtins that force a concrete host value out of their argument
+_CONCRETIZING_CALLS = {"float", "int", "bool", "complex"}
+# tracer methods that force a host sync
+_SYNC_METHODS = {"item", "tolist", "block_until_ready", "__array__"}
+# impure modules: calls through these inside a jitted body are trace-time
+# constants (jax.random is fine — different module root)
+_IMPURE_PREFIXES = ("time.", "random.", "numpy.random.")
+_IMPURE_MODULES = {"time", "random"}
+
+_JIT_TARGETS = {"jax.jit", "jax.api.jit"}
+_PARTIAL_TARGETS = {"functools.partial", "partial"}
+
+
+def _jit_static_argnames(call: ast.Call) -> set[str]:
+    """static_argnames values from a jax.jit/partial(jax.jit, ...) call."""
+    names: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                    names.add(node.value)
+    return names
+
+
+def _collect_jit_functions(ctx: FileCtx, imports: ImportMap):
+    """-> list of (FunctionDef, static_argnames) considered jit-compiled."""
+    defs = func_defs_by_name(ctx.tree)
+    jitted: dict[ast.FunctionDef, set[str]] = {}
+
+    def is_jit(node: ast.AST) -> bool:
+        target = imports.resolve_call_target(node)
+        return target in _JIT_TARGETS or dotted_name(node) in _JIT_TARGETS
+
+    for fn_list in defs.values():
+        for fn in fn_list:
+            for deco in fn.decorator_list:
+                if is_jit(deco):
+                    jitted.setdefault(fn, set())
+                elif isinstance(deco, ast.Call):
+                    target = imports.resolve_call_target(deco.func)
+                    if target in _PARTIAL_TARGETS and deco.args and is_jit(deco.args[0]):
+                        jitted.setdefault(fn, set()).update(_jit_static_argnames(deco))
+                    elif is_jit(deco.func):
+                        jitted.setdefault(fn, set()).update(_jit_static_argnames(deco))
+    # call-site wrapping: jax.jit(fn), jax.jit(vmap(fn)), jit(shard_map(f,..))
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and is_jit(node.func) and node.args):
+            continue
+        static = _jit_static_argnames(node)
+        for name_node in ast.walk(node.args[0]):
+            if isinstance(name_node, ast.Name):
+                for fn in defs.get(name_node.id, ()):
+                    jitted.setdefault(fn, set()).update(static)
+    return sorted(jitted.items(), key=lambda kv: kv[0].lineno)
+
+
+def _param_names(args: ast.arguments) -> list[str]:
+    params = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        params.append(args.vararg.arg)
+    if args.kwarg:
+        params.append(args.kwarg.arg)
+    return params
+
+
+class _JitBodyChecker:
+    """Walk one jitted function's body with a taint set, emitting findings."""
+
+    def __init__(self, ctx: FileCtx, imports: ImportMap, fn: ast.FunctionDef,
+                 static_argnames: set[str]):
+        self.ctx = ctx
+        self.imports = imports
+        self.fn = fn
+        self.findings: list[Finding] = []
+        self.tainted = {
+            name for name in _param_names(fn.args) if name not in static_argnames
+        }
+        # nested defs are analyzed AFTER the enclosing body (their param
+        # taint depends on how the body uses them — see _process_nested)
+        self._nested: list[ast.FunctionDef] = []
+
+    def run(self) -> None:
+        self.walk_body(self.fn.body)
+        self._process_nested(self.fn)
+
+    def _process_nested(self, enclosing: ast.FunctionDef) -> None:
+        """Analyze deferred nested defs.
+
+        A nested function handed BY NAME into jax machinery (``lax.scan``,
+        ``vmap``, ``pallas_call`` — any call argument position) runs under
+        the trace with tracer parameters: taint them all. A helper that is
+        only ever called directly gets per-parameter taint from its call
+        sites (``pad_to(x, N, fill)`` with static ``N`` must not flag
+        ``if x.shape[0] == n``).
+        """
+        pending, self._nested = self._nested, []
+        for nested in pending:
+            params = _param_names(nested.args)
+            escapes = False
+            site_taint: set[str] = set()
+            for node in ast.walk(enclosing):
+                if not isinstance(node, ast.Call):
+                    continue
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    if any(isinstance(n, ast.Name) and n.id == nested.name
+                           for n in ast.walk(arg)):
+                        escapes = True
+                if (isinstance(node.func, ast.Name)
+                        and node.func.id == nested.name):
+                    for param, arg in zip(params, node.args):
+                        if self.is_tainted(arg):
+                            site_taint.add(param)
+            outer = set(self.tainted)
+            self.tainted = (outer - set(params)) | (
+                set(params) if escapes else site_taint
+            )
+            self.walk_body(nested.body)
+            self._process_nested(nested)
+            self.tainted = outer
+
+    def emit(self, node: ast.AST, rule: str, message: str) -> None:
+        self.findings.append(Finding(
+            self.ctx.path, node.lineno, node.col_offset, rule,
+            f"{message} (in jitted `{self.fn.name}`)",
+        ))
+
+    # --- taint -----------------------------------------------------------
+
+    def is_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` compares identity, not value
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+        if isinstance(node, ast.Call):
+            func_name = dotted_name(node.func)
+            if func_name in _STATIC_CALLS:
+                return False
+            return any(self.is_tainted(c) for c in ast.iter_child_nodes(node))
+        if isinstance(node, ast.Lambda):
+            return False  # a lambda VALUE is not a tracer
+        return any(self.is_tainted(c) for c in ast.iter_child_nodes(node))
+
+    # --- expression hazards ---------------------------------------------
+
+    def scan_expr(self, node: ast.AST) -> None:
+        """Find host-sync / impure calls anywhere under an expression."""
+        if isinstance(node, ast.Lambda):
+            # lambda params are traced when the lambda feeds vmap/scan —
+            # but only WITHIN the lambda body (a sort-key lambda must not
+            # leak taint onto a same-named static name in the enclosing
+            # scope)
+            saved = set(self.tainted)
+            self.tainted.update(_param_names(node.args))
+            self.scan_expr(node.body)
+            self.tainted = saved
+            return
+        if isinstance(node, ast.Call):
+            self._check_call(node)
+        for child in ast.iter_child_nodes(node):
+            self.scan_expr(child)
+
+    def _check_call(self, child: ast.Call) -> None:
+        target = self.imports.resolve_call_target(child.func)
+        plain = dotted_name(child.func)
+        arg_tainted = any(
+            self.is_tainted(a) for a in child.args
+        ) or any(self.is_tainted(k.value) for k in child.keywords)
+        if target is not None and (
+            target.startswith(_IMPURE_PREFIXES)
+            or target in _IMPURE_MODULES
+        ):
+            self.emit(child, "jit-impure-call",
+                      f"`{plain}(...)` is impure under tracing: its result "
+                      "is frozen into the compiled program")
+        elif plain in _CONCRETIZING_CALLS and arg_tainted:
+            self.emit(child, "jit-host-sync",
+                      f"`{plain}()` on a traced value forces a host sync "
+                      "(concretization error on abstract tracers)")
+        elif (isinstance(child.func, ast.Attribute)
+                and child.func.attr in _SYNC_METHODS
+                and self.is_tainted(child.func.value)):
+            self.emit(child, "jit-host-sync",
+                      f"`.{child.func.attr}()` on a traced value forces a "
+                      "host sync")
+        elif (target is not None and target.startswith("numpy.")
+                and not target.startswith("numpy.random.")
+                and arg_tainted):
+            self.emit(child, "jit-host-sync",
+                      f"`{plain}(...)` is host numpy applied to a traced "
+                      "value; use jnp inside jit")
+
+    # --- statements ------------------------------------------------------
+
+    def assign_targets(self, target: ast.AST, taint: bool) -> None:
+        for node in ast.walk(target):
+            if isinstance(node, ast.Name):
+                if taint:
+                    self.tainted.add(node.id)
+                else:
+                    self.tainted.discard(node.id)
+
+    def walk_body(self, body: list[ast.stmt]) -> None:
+        for stmt in body:
+            self.walk_stmt(stmt)
+
+    def walk_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._nested.append(stmt)  # analyzed by _process_nested
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            if value is not None:
+                self.scan_expr(value)
+                taint = self.is_tainted(value)
+                targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+                if isinstance(stmt, ast.AugAssign):
+                    taint = taint or self.is_tainted(stmt.target)
+                for target in targets:
+                    self.assign_targets(target, taint)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self.scan_expr(stmt.test)
+            if self.is_tainted(stmt.test):
+                kind = "if" if isinstance(stmt, ast.If) else "while"
+                self.emit(stmt, "jit-tracer-branch",
+                          f"Python `{kind}` on a traced value; use jnp.where / "
+                          "lax.cond / lax.while_loop")
+            self.walk_body(stmt.body)
+            self.walk_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.For):
+            self.scan_expr(stmt.iter)
+            if self.is_tainted(stmt.iter):
+                self.emit(stmt, "jit-tracer-branch",
+                          "Python `for` over a traced value; use lax.scan / "
+                          "lax.fori_loop")
+            self.assign_targets(stmt.target, taint=True)
+            self.walk_body(stmt.body)
+            self.walk_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Assert):
+            self.scan_expr(stmt.test)
+            if self.is_tainted(stmt.test):
+                self.emit(stmt, "jit-tracer-branch",
+                          "`assert` on a traced value; use checkify or a "
+                          "host_callback debug check")
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self.scan_expr(item.context_expr)
+            self.walk_body(stmt.body)
+            return
+        if isinstance(stmt, ast.Try):
+            self.walk_body(stmt.body)
+            for handler in stmt.handlers:
+                self.walk_body(handler.body)
+            self.walk_body(stmt.orelse)
+            self.walk_body(stmt.finalbody)
+            return
+        # Return / Expr / Raise / everything else: scan embedded expressions,
+        # flagging ternaries on tracers along the way
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.IfExp):
+                if self.is_tainted(node.test):
+                    self.emit(node, "jit-tracer-branch",
+                              "ternary on a traced value; use jnp.where")
+        self.scan_expr(stmt)
+
+
+def check(project: Project) -> Iterator[Finding]:
+    for ctx in project.files:
+        imports = ImportMap(ctx.tree)
+        # cheap skip: no jax import, no jitted functions
+        if not any(m == "jax" or m.startswith("jax.")
+                   for m in list(imports.modules.values())
+                   + list(imports.from_imports.values())):
+            continue
+        seen: set[int] = set()
+        for fn, static in _collect_jit_functions(ctx, imports):
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            checker = _JitBodyChecker(ctx, imports, fn, static)
+            checker.run()
+            yield from checker.findings
