@@ -445,6 +445,74 @@ def scan_file(path: str | os.PathLike[str]) -> dict:
     return out
 
 
+def scan_manifests(fastq_pass_dir: str) -> list[dict]:
+    """Integrity scan of an EXISTING workdir's stage manifests (--validate).
+
+    For every ``nano_tcr/<library>/stage_manifest.json``: classify the
+    manifest (``v2`` / ``v1`` / ``torn``) and, for v2, verify every
+    completed stage's recorded artifacts with FULL sha256 checking — the
+    dry-run twin of ``verify_resume=full``, so an operator can audit a
+    workdir for silent corruption before committing compute to a resume.
+    Returns one dict per manifest: ``{library, path, status,
+    stages: {stage: reason|None}}`` (reason None = verified clean).
+    """
+    import glob
+    import json
+
+    from ont_tcrconsensus_tpu.io import layout
+
+    out: list[dict] = []
+    pattern = os.path.join(fastq_pass_dir, "nano_tcr", "*", "stage_manifest.json")
+    for mpath in sorted(glob.glob(pattern)):
+        lib_dir = os.path.dirname(mpath)
+        library = os.path.basename(lib_dir)
+        entry: dict = {"library": library, "path": mpath, "stages": {}}
+        try:
+            with open(mpath) as fh:
+                raw = json.load(fh)
+        except ValueError:
+            entry["status"] = "torn"
+            out.append(entry)
+            continue
+        except OSError as exc:
+            entry["status"] = "unreadable"
+            entry["error"] = str(exc)
+            out.append(entry)
+            continue
+        if not isinstance(raw, dict):
+            entry["status"] = "torn"
+            out.append(entry)
+            continue
+        if "version" in raw and not isinstance(raw.get("stages"), dict):
+            # valid JSON wearing a v2 header over a broken body: exactly
+            # the torn state this scan exists to flag (resume would redo
+            # the whole library) — never "v2, 0 stages, all clean"
+            entry["status"] = "torn"
+            out.append(entry)
+            continue
+        entry["status"] = "v2" if "version" in raw else "v1"
+        lay = layout.LibraryLayout(library=library, library_dir=lib_dir)
+        readable = lay.completed_stages()
+        # raw keys read_manifest() dropped are damaged entries — the
+        # operator should see them, not an undercount that looks clean
+        # (a v1 manifest is flat {stage: time}, so its own keys diff the
+        # same way as a v2 stages map)
+        raw_stages = raw["stages"] if entry["status"] == "v2" else raw
+        for stage in raw_stages:
+            if stage not in readable:
+                entry["stages"][str(stage)] = (
+                    "malformed manifest entry (resume will redo it)"
+                )
+        for stage in readable:
+            if entry["status"] == "v1":
+                entry["stages"][stage] = "v1 entry — no checksums recorded"
+                continue
+            ok, why = lay.verify_stage(stage, "full")
+            entry["stages"][stage] = None if ok else why
+        out.append(entry)
+    return out
+
+
 def _find_fastqs(fastq_pass_dir: str) -> list[str]:
     # same two-pattern discovery as pipeline/run.py (duplicated so the
     # dry-run never imports the jax-bearing pipeline modules)
@@ -518,6 +586,45 @@ def validate_inputs(config_path: str, out=None) -> int:
         p(line)
     if fastqs and not total_records:
         problems.append("input files contain zero parseable records")
+
+    # existing-workdir integrity: stage manifests + completed-artifact
+    # checksums (the --validate twin of verify_resume=full). A v1 manifest
+    # is informational (legacy runs are not an error — resume under
+    # fast/full will warn and re-run); torn manifests and checksum
+    # mismatches are problems an operator should see BEFORE a resume.
+    for m in scan_manifests(cfg.fastq_pass_dir):
+        if m["status"] in ("torn", "unreadable"):
+            p(f"validate: manifest {m['path']}: {m['status'].upper()}")
+            problems.append(
+                f"{m['path']}: {m['status']} stage manifest (resume will "
+                "redo the library; a crash mid-write or disk fault)"
+            )
+            continue
+        bad = {s: why for s, why in m["stages"].items() if why is not None}
+        n_ok = len(m["stages"]) - len(bad)
+        line = (f"validate: manifest {m['path']} ({m['status']}): "
+                f"{len(m['stages'])} stage(s), {n_ok} verified")
+        if m["status"] == "v1":
+            p(line + " — v1 (no checksums; verified resume will re-run)")
+            # legacy-ness is informational, but a DROPPED (malformed) v1
+            # entry is the same damage a v2 audit flags — same verdict
+            for stage, why in bad.items():
+                if "malformed" in why:
+                    problems.append(f"{m['path']}: stage {stage!r}: {why}")
+            continue
+        p(line)
+        for stage, why in bad.items():
+            if "no checksums recorded" in why:
+                # a migrated manifest's v1-era entries (artifacts: null):
+                # legacy, not damage — same informational verdict as a
+                # pure-v1 manifest; verified resume will warn and re-run
+                p(f"validate:   stage {stage!r}: v1-era entry (no "
+                  "checksums; verified resume will re-run)")
+                continue
+            problems.append(
+                f"{m['path']}: stage {stage!r} failed artifact "
+                f"verification: {why}"
+            )
 
     if problems:
         for prob in problems:

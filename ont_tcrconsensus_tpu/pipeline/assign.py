@@ -36,6 +36,7 @@ import numpy as np
 from ont_tcrconsensus_tpu.io import bucketing, fastx
 from ont_tcrconsensus_tpu.ops import ee_filter, encode, fuzzy_match, sketch, sw_pallas
 from ont_tcrconsensus_tpu.robustness import faults as robustness_faults
+from ont_tcrconsensus_tpu.robustness import watchdog
 
 MIN_SCORE = 100  # SW score gate for a "primary alignment" equivalent
 BIG_DIST = 1 << 20  # sentinel distance for "no qualifying primer hit"
@@ -1140,6 +1141,11 @@ def run_assign(
     )
     try:
         for batch in prefetch_gen:
+            # liveness: one heartbeat per ingest batch — a wedged parser,
+            # prefetch worker, or device dispatch stops these, and the
+            # stage watchdog (pipeline-level guard) cancels into the
+            # transient retry of the whole idempotent pass
+            watchdog.heartbeat("assign.batch")
             if not acquire_permit():
                 break
             # chaos site: a transient device fault on the fused-pass

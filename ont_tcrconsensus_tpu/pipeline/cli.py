@@ -14,11 +14,32 @@ filesystem data plane.
 from __future__ import annotations
 
 import argparse
+import faulthandler
 import os
+import signal
 import sys
 
 
+def _install_stack_dump_signal() -> None:
+    """SIGQUIT (Ctrl-\\ / ``kill -QUIT``) -> all-thread stack dump.
+
+    The post-hoc diagnosis hook for a wedged production run: even with the
+    watchdog disarmed, an operator can always extract every thread's stack
+    without killing the process. The pipeline additionally re-registers
+    the dump into ``<nano_tcr>/stack_dumps_p<proc>.log`` once the output
+    tree exists (pipeline/run.py), and the watchdog writes its own dumps
+    to the per-library log on every stall it detects.
+    """
+    if not hasattr(signal, "SIGQUIT"):
+        return  # non-POSIX platform: diagnosis via the watchdog log only
+    try:
+        faulthandler.register(signal.SIGQUIT, all_threads=True)
+    except (ValueError, OSError, AttributeError):
+        pass  # exotic runtime without signal support: never fatal
+
+
 def main(argv: list[str] | None = None) -> int:
+    _install_stack_dump_signal()
     parser = argparse.ArgumentParser(
         description="Count unique TCR molecule nanopore consensus reads (TPU-native)."
     )
@@ -33,8 +54,10 @@ def main(argv: list[str] | None = None) -> int:
         "--validate", action="store_true",
         help="Dry-run input validation: parse the config, scan every input "
         "file (record counts/sizes via the tolerant parser — no device "
-        "work, no jax import), print a validation report, and exit "
-        "non-zero on any problem.",
+        "work, no jax import), audit any existing workdir's stage "
+        "manifests (torn/v1 manifests, full sha256 over completed "
+        "artifacts), print a validation report, and exit non-zero on any "
+        "problem.",
     )
     args = parser.parse_args(argv)
 

@@ -179,6 +179,24 @@ class RunConfig:
     #   machine-readable reasons in robustness_report.json; "drop" counts +
     #   reports without keeping the bytes. Truncated gzip and truncated
     #   final records become quarantine events instead of tracebacks.
+    stage_timeout_s: float | None = None  # liveness watchdog
+    #   (robustness/watchdog.py): base HARD deadline per pipeline stage,
+    #   measured from the stage's last heartbeat and auto-scaled by
+    #   workload size (base covers 1000 work units; larger workloads scale
+    #   linearly — watchdog.scaled_timeout). At half the hard deadline a
+    #   stall event + all-thread stack dump land in the robustness report /
+    #   library log; at the hard deadline the stalled stage is cancelled
+    #   with a StageTimeout, which retries as a transient fault. None
+    #   (default) disarms the watchdog entirely (heartbeats are one global
+    #   check). Size for the SLOWEST legitimate single dispatch including
+    #   cold compiles — e.g. 600 for production lanes
+    verify_resume: str = "fast"  # resume integrity checking against the
+    #   v2 stage manifest's recorded artifact checksums (io/layout.py):
+    #   "off" trusts the manifest mark alone (legacy blind-trust), "fast"
+    #   (default) checks artifact byte sizes (catches truncation/missing
+    #   files, ~free), "full" re-hashes sha256 (catches any bit rot). A
+    #   failed/unverifiable stage (v1 manifest) warns and re-runs instead
+    #   of resuming from garbage
     contracts: str = "warn"  # stage-boundary conservation contracts
     #   (robustness/contracts.py): "off" skips the checks, "warn" (default)
     #   logs + records violations in robustness_report.json, "strict"
@@ -305,6 +323,19 @@ class RunConfig:
         if self.contracts not in ("off", "warn", "strict"):
             raise ValueError(
                 f"contracts={self.contracts!r} not in ('off', 'warn', 'strict')"
+            )
+        if self.stage_timeout_s is not None and not (
+            isinstance(self.stage_timeout_s, (int, float))
+            and self.stage_timeout_s > 0
+        ):
+            raise ValueError(
+                f"stage_timeout_s={self.stage_timeout_s!r} must be a "
+                "positive number or null (null = watchdog disarmed)"
+            )
+        if self.verify_resume not in ("off", "fast", "full"):
+            raise ValueError(
+                f"verify_resume={self.verify_resume!r} not in "
+                "('off', 'fast', 'full')"
             )
         for pat_name in ("umi_fwd", "umi_rev"):
             pat = getattr(self, pat_name)

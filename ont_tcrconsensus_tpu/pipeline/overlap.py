@@ -35,14 +35,16 @@ from __future__ import annotations
 import threading
 import time
 
-from ont_tcrconsensus_tpu.robustness import faults
+from ont_tcrconsensus_tpu.robustness import faults, watchdog
 
 
 class DeferredStage:
     """One background stage: compute on a worker, result at commit time."""
 
-    def __init__(self, name: str, permits: threading.Semaphore):
+    def __init__(self, name: str, permits: threading.Semaphore,
+                 units: int = 0):
         self.name = name
+        self.units = units
         self._permits = permits
         self._done = threading.Event()
         self._result = None
@@ -53,10 +55,17 @@ class DeferredStage:
     def _run(self, fn, args, kwargs) -> None:
         t0 = time.perf_counter()
         try:
-            # chaos site: a worker thread dying mid-stage (the injected
-            # exception surfaces at commit, like any real worker failure)
-            faults.inject("overlap.worker")
-            self._result = fn(*args, **kwargs)
+            # liveness: the worker registers its OWN watchdog scope (the
+            # main thread's guards are per-thread), deadline-scaled by the
+            # caller's workload hint — a stalled worker is cancelled with
+            # a StageTimeout that surfaces at commit and takes the
+            # existing recompute-synchronously path
+            with watchdog.guard(f"overlap.{self.name}", units=self.units):
+                # chaos site: a worker thread dying mid-stage (the injected
+                # exception surfaces at commit, like any real worker failure)
+                faults.inject("overlap.worker")
+                watchdog.heartbeat("overlap.worker")
+                self._result = fn(*args, **kwargs)
         except BaseException as exc:  # re-raised on the main thread at commit
             self._exc = exc
         finally:
@@ -101,11 +110,17 @@ class StageExecutor:
         self._permits = threading.Semaphore(max_in_flight)
         self._pending: list[DeferredStage] = []
 
-    def submit(self, name: str, fn, /, *args, **kwargs) -> DeferredStage:
+    def submit(self, name: str, fn, /, *args, units: int = 0,
+               **kwargs) -> DeferredStage:
         """Start ``fn(*args, **kwargs)`` on a worker thread; blocks only
-        when ``max_in_flight`` stages are already live."""
+        when ``max_in_flight`` stages are already live.
+
+        ``units`` is the watchdog workload hint for the worker's deadline
+        (``watchdog.scaled_timeout``): size it to the stage's work-item
+        count so a big background pass is not falsely cancelled. Stages
+        whose fn heartbeats internally can leave it 0 (base deadline)."""
         self._permits.acquire()
-        stage = DeferredStage(name, self._permits)
+        stage = DeferredStage(name, self._permits, units=units)
         stage._call = (fn, args, kwargs)
         threading.Thread(
             target=stage._run, args=(fn, args, kwargs),

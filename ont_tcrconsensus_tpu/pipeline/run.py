@@ -23,11 +23,13 @@ Ray fan-out (tcr_consensus.py:141-167; SURVEY §2.3).
 
 from __future__ import annotations
 
+import faulthandler
 import glob
 import json
 import os
 import re
 import shutil
+import signal
 import sys
 
 import numpy as np
@@ -39,7 +41,13 @@ from ont_tcrconsensus_tpu.pipeline import overlap, stages
 from ont_tcrconsensus_tpu.pipeline.config import RunConfig
 from ont_tcrconsensus_tpu.qc import artifacts, umi_overlap
 from ont_tcrconsensus_tpu.qc.timing import StageTimer
-from ont_tcrconsensus_tpu.robustness import contracts, faults, retry, shutdown
+from ont_tcrconsensus_tpu.robustness import (
+    contracts,
+    faults,
+    retry,
+    shutdown,
+    watchdog,
+)
 
 # fallback precision bar when no reference pair survives the homology filter
 # (the reference would crash there; see cluster/regions.py docstring)
@@ -139,7 +147,88 @@ def run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]:
     return _run_with_config(cfg, polisher)
 
 
+class _SigquitRunLog:
+    """Per-run SIGQUIT -> run-log faulthandler registration.
+
+    ``restore()`` reinstates the PRE-run state: the CLI's stderr dump if
+    it was installed, otherwise the embedder's original SIGQUIT
+    disposition — a library caller must not inherit a process-global
+    handler from one run.
+    """
+
+    def __init__(self):
+        self.fh = None
+        self.had_stderr_dump = False
+
+    def register(self, nano_dir: str, proc_id: int) -> None:
+        if not hasattr(signal, "SIGQUIT"):
+            return
+        try:
+            self.fh = open(
+                os.path.join(nano_dir, f"stack_dumps_p{proc_id}.log"), "a"
+            )
+            # unregister first so our register saves the TRUE
+            # pre-faulthandler handler as its restore point; the return
+            # value remembers whether the CLI's stderr dump was installed
+            self.had_stderr_dump = faulthandler.unregister(signal.SIGQUIT)
+            # chain=False (default) on purpose: chain would fall through to
+            # the handler that predates faulthandler's FIRST registration —
+            # SIG_DFL, which TERMINATES the process. A diagnosis dump must
+            # never kill the run it is diagnosing.
+            faulthandler.register(signal.SIGQUIT, file=self.fh, all_threads=True)
+        except (OSError, ValueError, AttributeError) as exc:
+            _log(f"stack-dump registration unavailable: {exc!r}")
+            if self.fh is not None:
+                self.fh.close()
+            self.fh = None
+            if self.had_stderr_dump:
+                try:
+                    faulthandler.register(signal.SIGQUIT, all_threads=True)
+                except (OSError, ValueError, AttributeError):
+                    pass
+
+    def restore(self) -> None:
+        if self.fh is None:
+            return
+        try:
+            faulthandler.unregister(signal.SIGQUIT)
+            if self.had_stderr_dump:
+                faulthandler.register(signal.SIGQUIT, all_threads=True)
+        except (OSError, ValueError, AttributeError):
+            pass
+        self.fh.close()
+        self.fh = None
+
+
 def _run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]:
+    # The watchdog and the per-run SIGQUIT registration are process-global
+    # state: arm them HERE, around the whole body, so every exit path —
+    # the only_run_reference_self_homology early return, a pre-loop
+    # discovery error, a failed reference read — disarms the monitor and
+    # restores the pre-run SIGQUIT disposition. An embedder's next
+    # run_with_config call must never inherit this run's deadline monitor
+    # or dump handler.
+    wd = None
+    if cfg.stage_timeout_s:
+        wd = watchdog.Watchdog(base_timeout_s=cfg.stage_timeout_s)
+        watchdog.activate(wd)
+        wd.start()
+        _log(f"Watchdog armed: stage_timeout_s={cfg.stage_timeout_s} "
+             f"(soft at {watchdog.SOFT_FRACTION:.0%}, auto-scaled by "
+             "workload size)")
+    sigquit_log = _SigquitRunLog()
+    try:
+        return _run_with_config_body(cfg, polisher, sigquit_log)
+    finally:
+        if wd is not None:
+            watchdog.deactivate(wd)
+            wd.stop()
+        sigquit_log.restore()
+
+
+def _run_with_config_body(
+    cfg: RunConfig, polisher, sigquit_log: _SigquitRunLog,
+) -> dict[str, dict[str, int]]:
     from ont_tcrconsensus_tpu.parallel import distributed as dist
 
     enable_compilation_cache()
@@ -210,6 +299,11 @@ def _run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]
     if proc_id == 0:
         os.makedirs(nano_dir, exist_ok=True)
     dist.barrier("nano_dir_init")  # dir visible before any other host proceeds
+    # SIGQUIT -> all-thread stack dump into the run's own log (in addition
+    # to the CLI's stderr registration): a wedged production run is always
+    # diagnosable post-hoc from the output tree, even when stderr was lost.
+    # The wrapper's finally restores the pre-run disposition on every exit.
+    sigquit_log.register(nano_dir, proc_id)
 
     # PHASE A: reference self-homology (tcr_consensus.py:90-105)
     _log("Mapping reference self homology")
@@ -307,11 +401,16 @@ def _run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]
             # this guard can never swallow a shutdown into a skip.
             try:
                 lay = layout.init_library_dir(fastq, nano_dir, resume=cfg.resume)
+                watchdog.set_log_path(os.path.join(lay.logs, "watchdog.log"))
                 if cfg.resume and lay.stage_done("counts"):
-                    _log("Library already complete:", lay.library)
                     counts_csv = os.path.join(lay.counts, "umi_consensus_counts.csv")
-                    results[lay.library] = _read_counts_csv(counts_csv)
-                    continue
+                    # chaos site: disk corruption landing on a completed
+                    # artifact between the original run and this resume
+                    faults.corrupt_artifact("resume.verify", counts_csv)
+                    if _verify_resume_stage(lay, "counts", cfg):
+                        _log("Library already complete:", lay.library)
+                        results[lay.library] = _read_counts_csv(counts_csv)
+                        continue
                 results[lay.library] = _run_library(
                     fastq, lay, cfg, panel, engine, engine_notrim,
                     blast_id_threshold, overlap_consensus, polisher,
@@ -364,6 +463,28 @@ def _run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]
         )
     _log("Done running all barcodes!")
     return results
+
+
+def _verify_resume_stage(lay, stage: str, cfg) -> bool:
+    """Gate a resume skip on artifact integrity (``verify_resume``).
+
+    True -> the stage's recorded artifacts check out (or checking is off):
+    safe to skip. False -> mismatch/unverifiable: the caller re-runs the
+    stage; the decision is recorded at the ``resume.verify`` site in
+    ``robustness_report.json`` so a silent-corruption recovery is an
+    auditable event, not a log line.
+    """
+    ok, why = lay.verify_stage(stage, cfg.verify_resume)
+    if ok:
+        return True
+    retry.recorder().record(
+        "resume.verify", classification="integrity", outcome="rerun",
+        error=why or "", detail={"library": lay.library, "stage": stage,
+                                 "mode": cfg.verify_resume},
+    )
+    _log(f"WARNING: resume verification failed for {lay.library} stage "
+         f"{stage!r} ({why}); re-running instead of trusting the artifact")
+    return False
 
 
 def _run_library(fastq, lay, cfg, panel, engine, engine_notrim,
@@ -439,15 +560,19 @@ def _run_library_impl(fastq, lay, cfg, panel, engine, engine_notrim,
     timer = StageTimer()
 
     # stage-level resume: a completed round 1 is reloaded from its artifact
+    # — after integrity verification (verify_resume): a torn or bit-rotted
+    # consensus fasta must re-run round 1, not silently seed round 2
     if cfg.resume and lay.stage_done("round1_consensus") and os.path.exists(merged_path):
-        _log("Resuming from round-1 consensus:", library)
-        merged_consensus = [
-            (rec.header, rec.sequence) for rec in fastx.read_fastx(merged_path)
-        ]
-        return _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
-                           overlap_consensus, merged_consensus, timer,
-                           read_batch, budget, round1_complete=True,
-                           qc_exec=qc_exec)
+        faults.corrupt_artifact("resume.verify", merged_path)
+        if _verify_resume_stage(lay, "round1_consensus", cfg):
+            _log("Resuming from round-1 consensus:", library)
+            merged_consensus = [
+                (rec.header, rec.sequence) for rec in fastx.read_fastx(merged_path)
+            ]
+            return _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
+                               overlap_consensus, merged_consensus, timer,
+                               read_batch, budget, round1_complete=True,
+                               qc_exec=qc_exec)
 
     # PHASE B + round-1 assignment: ONE fused device pass per batch
     # (trim -> EE -> align -> UMI locate; preprocessing.py:7-159 +
@@ -465,7 +590,10 @@ def _run_library_impl(fastq, lay, cfg, panel, engine, engine_notrim,
             quarantine_path=lay.quarantine_path,
         )
     try:
-        with timer.stage("round1_fused_assign"):
+        # watchdog guard + per-batch heartbeats (assign.py drive loop): a
+        # hung dispatch cancels into the same transient-retry wrapper
+        with timer.stage("round1_fused_assign"), \
+                watchdog.guard("round1_fused_assign"):
             # transient-retry wrap: the fused pass is idempotent (it
             # streams the fastq into a fresh store), so a dropped device
             # connection mid-library re-runs the whole pass instead of
@@ -526,6 +654,7 @@ def _run_library_impl(fastq, lay, cfg, panel, engine, engine_notrim,
                 qc_exec.submit(
                     "round1_error_profile", error_profile.profile_store,
                     store, panel, sample_size=cfg.error_profile_sample,
+                    units=cfg.error_profile_sample,
                 ),
                 r1_log,
             ))
@@ -601,8 +730,14 @@ def _run_library_impl(fastq, lay, cfg, panel, engine, engine_notrim,
         try:
             # transients retry the batched pass; a deterministic failure
             # (or an exhausted policy) degrades to the per-group retry
-            # loop below so one bad group cannot poison its peers
-            grouped = retry.call_with_retry("cluster.batched_round1", _batched_r1)
+            # loop below so one bad group cannot poison its peers. The
+            # watchdog guard makes a HUNG pass a transient too: hard-
+            # deadline cancel -> StageTimeout -> this same retry wrapper.
+            with watchdog.guard(
+                "round1_umi_cluster",
+                units=sum(len(u) for _, u in records_by_group),
+            ):
+                grouped = retry.call_with_retry("cluster.batched_round1", _batched_r1)
         except Exception as exc:
             retry.recorder().record(
                 "cluster.batched_round1", classification=retry.classify(exc),
@@ -639,7 +774,10 @@ def _run_library_impl(fastq, lay, cfg, panel, engine, engine_notrim,
     n_clusters = sum(len(s) for _, s in selected_by_group)
     _log(f"Polishing clusters: {library} "
          f"({n_clusters} clusters over {len(selected_by_group)} region clusters)")
-    with timer.stage("round1_polish"):
+    # watchdog guard scaled by cluster count; the chunk loop heartbeats
+    # per dispatch, so only a chunk that stops progressing can expire
+    with timer.stage("round1_polish"), \
+            watchdog.guard("round1_polish", units=n_clusters):
         by_group, polish_failed = stages.polish_clusters_all(
             selected_by_group, store,
             max_read_length=cfg.max_read_length,
@@ -687,8 +825,10 @@ def _run_library_impl(fastq, lay, cfg, panel, engine, engine_notrim,
     )
     if not failed_groups:
         # incomplete round 1 is NOT checkpointed: resume must retry the
-        # failed groups instead of reusing a consensus missing them
-        lay.mark_stage_done("round1_consensus")
+        # failed groups instead of reusing a consensus missing them.
+        # The artifact is checksummed into the v2 manifest so resume can
+        # verify it before seeding round 2 from it.
+        lay.mark_stage_done("round1_consensus", artifacts=[merged_path])
     # chaos site + preemption checkpoint at the round-1 commit: the
     # canonical mid-stage death — the manifest just committed, so a kill
     # here resumes into round 2 only, byte-identically
@@ -776,7 +916,8 @@ def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
         if dispatch is None:
             _log(f"round 2: targeted assign unavailable ({why_not}); "
                  "falling back to the full fused assign")
-    with timer.stage("round2_fused_assign"):
+    with timer.stage("round2_fused_assign"), \
+            watchdog.guard("round2_fused_assign", units=len(cons_records)):
         # transient-retry wrap like round 1; qc_rows is cleared before
         # each retry so a half-consumed attempt cannot duplicate QC rows
         cons_store, cstats = retry.call_with_retry(
@@ -818,6 +959,7 @@ def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
                 qc_exec.submit(
                     "round2_error_profile", error_profile.profile_store,
                     cons_store, panel, sample_size=cfg.error_profile_sample,
+                    units=cfg.error_profile_sample,
                 ),
                 r2_log,
             ))
@@ -877,7 +1019,13 @@ def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
             )
 
         try:
-            grouped2 = retry.call_with_retry("cluster.batched_round2", _batched_r2)
+            # watchdog-guarded like round 1: a hung batched pass cancels
+            # into this retry wrapper instead of wedging the run
+            with watchdog.guard(
+                "round2_umi_cluster",
+                units=sum(len(u) for _, u in region_records),
+            ):
+                grouped2 = retry.call_with_retry("cluster.batched_round2", _batched_r2)
         except Exception as exc:
             retry.recorder().record(
                 "cluster.batched_round2", classification=retry.classify(exc),
@@ -935,8 +1083,11 @@ def _run_round2(lay, cfg, panel, engine_notrim, blast_id_threshold,
     timer.write_tsv(os.path.join(lay.logs, "stage_timing.tsv"))
     if round1_complete and not failed_regions:
         # incomplete counts are not checkpointed: resume must retry the
-        # failed groups/regions instead of trusting a partial CSV
-        lay.mark_stage_done("counts")
+        # failed groups/regions instead of trusting a partial CSV. Only
+        # the CSV is checksummed: the intermediates are regenerable (and
+        # deleted under delete_tmp_files) — the counts CSV is the
+        # library's contract with downstream analysis.
+        lay.mark_stage_done("counts", artifacts=[counts_csv])
 
     if cfg.delete_tmp_files:
         for d in (lay.region_cluster_fasta, lay.clustering, lay.umi_fasta,

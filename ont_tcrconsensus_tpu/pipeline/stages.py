@@ -22,7 +22,7 @@ from ont_tcrconsensus_tpu.cluster import umi as umi_mod
 from ont_tcrconsensus_tpu.io import bucketing, fastx
 from ont_tcrconsensus_tpu.ops import consensus as consensus_mod
 from ont_tcrconsensus_tpu.ops import encode
-from ont_tcrconsensus_tpu.robustness import contracts, faults, retry
+from ont_tcrconsensus_tpu.robustness import contracts, faults, retry, watchdog
 from ont_tcrconsensus_tpu.pipeline.assign import (  # noqa: F401  (re-exported)
     AlignStats,
     AssignEngine,
@@ -236,6 +236,7 @@ def cluster_and_select_grouped(
         for name, records in named_records
     ]
     groups = [[r.combined for r in recs] for _, recs in eligibles]
+    watchdog.heartbeat("cluster.batched_dispatch")
     clusters_list = umi_mod.cluster_umis_grouped(groups, identity, mesh=mesh)
     out: dict[str, tuple[list[SelectedCluster], list[dict]]] = {}
     # first selection pass (host-only), collecting the rescue work so the
@@ -261,6 +262,7 @@ def cluster_and_select_grouped(
         if rescue_work else {}
     )
     for name, (recs, clusters, selected, stat_rows) in first_pass.items():
+        watchdog.heartbeat("cluster.group_select")
         roots = roots_by.get(name)
         if roots is not None:
             selected, stat_rows, _ = _run_selection(
@@ -770,6 +772,10 @@ def polish_clusters_all(
                 attempt = 1
                 while True:
                     try:
+                        # liveness: each chunk dispatch is one heartbeat —
+                        # the watchdog only fires when a DISPATCH stops
+                        # progressing, never from many fast chunks
+                        watchdog.heartbeat("polish.chunk")
                         faults.inject("polish.dispatch")
                         seqs = _dispatch_polish_chunk(
                             chunk, cb_run, s_bucket, width, rounds=rounds,

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ont_tcrconsensus_tpu.robustness import watchdog
+
 _BASE = "acgtn"  # cs syntax is lowercase
 
 
@@ -536,6 +538,11 @@ def profile_store(store, panel, sample_size: int = 1000, seed: int = 0,
     tag_region: dict[str, Counter] = defaultdict(Counter)
     tag_blast: dict[str, Counter] = defaultdict(Counter)
     for s in range(0, len(handles), chunk):
+        # liveness: one heartbeat per profiled chunk — this runs on an
+        # overlapped worker under its own watchdog guard (overlap.py), so
+        # a long sample must report progress or a wedged dispatch would be
+        # indistinguishable from legitimate bulk work
+        watchdog.heartbeat("qc.error_profile_chunk")
         part = handles[s : s + chunk]
         queries, ref_spans = [], []
         for bi, r in part:

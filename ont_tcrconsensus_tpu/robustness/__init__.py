@@ -18,6 +18,14 @@ writes) need no config plumbing:
   at the next stage boundary, drains overlapped workers, and exits with
   every fully-committed checkpoint intact so ``resume=true`` continues
   byte-identically.
+- :mod:`.watchdog` — liveness watchdog: per-stage soft/hard deadlines
+  over a cheap ``heartbeat(site)`` API planted in the long-running loops
+  (config ``stage_timeout_s``, auto-scaled by workload size). A soft
+  expiry emits a ``watchdog.stall`` report event plus an all-thread stack
+  dump to the library log; a hard expiry cancels the stalled stage with
+  :class:`~.watchdog.StageTimeout`, which the classifier treats as a
+  retryable transient — a hung dispatch re-enters the retry/degrade path
+  instead of wedging the run.
 - :mod:`.contracts` — stage-boundary conservation contracts: runtime
   accounting invariants (reads ingested == assigned + filtered +
   quarantined, UMI counts conserved across the rescue pass, consensus
@@ -30,4 +38,5 @@ from ont_tcrconsensus_tpu.robustness import (  # noqa: F401
     faults,
     retry,
     shutdown,
+    watchdog,
 )

@@ -54,6 +54,7 @@ import os
 import random
 import sys
 import threading
+import time
 
 ENV_VAR = "TCR_CHAOS"
 
@@ -66,8 +67,19 @@ ENV_VAR = "TCR_CHAOS"
 #: every downstream artifact — must stay byte-identical to an uncorrupted
 #: run. ``truncate-file`` cuts the file mid-stream (for ``.gz`` inputs:
 #: mid gzip stream), losing the tail.
+#: ``stall`` / ``hang`` are LIVENESS faults: the injection point stops
+#: making progress instead of raising, and only the stage watchdog
+#: (robustness/watchdog.py, config ``stage_timeout_s``) can end it.
+#: ``stall`` wedges in an interruptible Python loop (the watchdog's
+#: hard-deadline cancel lands promptly); ``hang`` wedges in ONE long
+#: C-level call, like a hung XLA dispatch — detected and stack-dumped on
+#: time, cancelled only when the call returns. ``corrupt-artifact`` is a
+#: RESUME-integrity fault: it flips a byte of a completed stage's artifact
+#: in place (size-preserving, so only ``verify_resume=full`` checksums can
+#: catch it) through :func:`corrupt_artifact` at ``resume.verify``.
 KINDS = ("transient", "oom", "error", "kill", "preempt", "torn",
-         "corrupt-input", "truncate-file")
+         "corrupt-input", "truncate-file", "stall", "hang",
+         "corrupt-artifact")
 
 #: every injection point planted in the pipeline; arming an unknown site is
 #: an error so chaos-plan typos fail fast instead of silently never firing
@@ -80,6 +92,7 @@ KNOWN_SITES = frozenset({
     "layout.manifest_write",
     "run.round1_checkpoint",
     "ingest.library_fastq",
+    "resume.verify",
 })
 
 KILL_EXIT_CODE = 137
@@ -231,7 +244,53 @@ def _fire(spec: FaultSpec, site: str) -> None:
 
         shutdown.request(reason=f"chaos preempt at {site}")
         return
+    if spec.kind in ("stall", "hang"):
+        _stall_until_cancelled(spec.kind, site)
     raise AssertionError(f"unhandled chaos kind {spec.kind!r}")  # pragma: no cover
+
+
+#: safety cap on an injected stall/hang: if the watchdog is disarmed or
+#: dead, the wedge self-reports instead of hanging the test suite forever
+STALL_CAP_S = 60.0
+
+
+def _stall_until_cancelled(kind: str, site: str) -> None:
+    """Stop making progress until the watchdog cancels this thread.
+
+    ``stall``: an interruptible Python sleep loop — the watchdog's
+    hard-deadline ``StageTimeout`` (PyThreadState_SetAsyncExc) is
+    delivered between the slices, promptly. ``hang``: ONE long C-level
+    ``time.sleep`` sized past the active hard deadline, like a wedged XLA
+    dispatch — the cancel is queued on time but only lands when the call
+    returns. Either way the pending StageTimeout raises at the next
+    bytecode after the sleep, so the code below the sleeps is reached
+    only when the watchdog never cancelled us.
+    """
+    from ont_tcrconsensus_tpu.robustness import watchdog
+
+    sys.stderr.write(f"CHAOS: injected {kind} at {site} "
+                     "(progress stops; only the watchdog can end this)\n")
+    sys.stderr.flush()
+    hard = watchdog.active_deadline_s()
+    if hard is not None and hard + 2.0 > STALL_CAP_S:
+        # the wedge would end BEFORE the watchdog's hard deadline and the
+        # fallthrough below would wrongly diagnose a disarmed watchdog —
+        # refuse the drill loudly instead
+        raise RuntimeError(
+            f"injected {kind} at {site}: active hard deadline {hard:.0f}s "
+            f"exceeds the {STALL_CAP_S:.0f}s stall safety cap — shrink "
+            "stage_timeout_s for this chaos drill"
+        )
+    if kind == "hang":
+        time.sleep((hard or 5.0) + 2.0)
+    else:
+        deadline = time.monotonic() + STALL_CAP_S
+        while time.monotonic() < deadline:
+            time.sleep(0.02)
+    raise RuntimeError(
+        f"injected {kind} at {site} was never cancelled — is the stage "
+        f"watchdog armed (stage_timeout_s) and the site inside a guard?"
+    )
 
 
 def inject(site: str) -> None:
@@ -333,6 +392,46 @@ def mutate_input(site: str, path: str) -> str:
     sys.stderr.write(f"CHAOS: corrupted input copy {out_path} "
                      f"({len(slots)} bad blocks) at {site}\n")
     return out_path
+
+
+def corrupt_artifact(site: str, path: str) -> bool:
+    """Resume-integrity chaos for verification sites: mutate a COMPLETED
+    artifact in place, simulating disk/firmware corruption between a run
+    and its resume.
+
+    When a ``corrupt-artifact`` spec fires at ``site``, the middle byte of
+    ``path`` is flipped to an ASCII digit. Size-preserving on purpose:
+    ``verify_resume=fast`` (size check) must MISS it and only ``full``
+    (sha256) may catch it — and a digit keeps a counts CSV parseable, so
+    ``verify_resume=off`` demonstrates true blind trust (valid-looking
+    garbage flows through) instead of a parse crash. Returns True when it
+    fired; other armed kinds at the site fire through :func:`_fire`.
+    """
+    if _PLAN is None:
+        return False
+    spec = _PLAN.hit(site)
+    if spec is None:
+        return False
+    if spec.kind != "corrupt-artifact":
+        _fire(spec, site)
+        return False
+    if not os.path.exists(path):
+        sys.stderr.write(f"CHAOS: corrupt-artifact at {site}: {path} "
+                         "does not exist; nothing to corrupt\n")
+        return False
+    with open(path, "r+b") as fh:
+        data = fh.read()
+        if not data:
+            sys.stderr.write(f"CHAOS: corrupt-artifact at {site}: {path} "
+                             "is empty; nothing to corrupt\n")
+            return False
+        pos = len(data) // 2
+        new = b"7" if data[pos:pos + 1] != b"7" else b"8"
+        fh.seek(pos)
+        fh.write(new)
+    sys.stderr.write(f"CHAOS: corrupted artifact {path} "
+                     f"(byte {pos} -> {new!r}) at {site}\n")
+    return True
 
 
 def tear_write(site: str, path: str, payload: str) -> bool:

@@ -28,7 +28,7 @@ import random
 import threading
 import time
 
-from ont_tcrconsensus_tpu.robustness import faults
+from ont_tcrconsensus_tpu.robustness import faults, watchdog
 
 #: substrings marking an exception as HBM/host memory exhaustion. Checked
 #: BEFORE the transient markers: XLA OOM messages often also mention the
@@ -66,6 +66,11 @@ def classify(exc: BaseException) -> str:
     if isinstance(exc, faults.OomChaosError) or isinstance(exc, MemoryError):
         return "oom"
     if isinstance(exc, faults.TransientChaosError):
+        return "transient"
+    if isinstance(exc, watchdog.StageTimeout):
+        # a watchdog-cancelled stall: retrying the dispatch is exactly the
+        # MapReduce straggler answer (the message also carries the
+        # DEADLINE_EXCEEDED marker, but the isinstance is authoritative)
         return "transient"
     if isinstance(exc, (ConnectionError, TimeoutError, BrokenPipeError)):
         return "transient"

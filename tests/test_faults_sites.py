@@ -36,7 +36,8 @@ def test_unknown_site_rejected_at_arm():
 
 def test_unknown_kind_and_bad_p_rejected():
     with pytest.raises(ValueError, match="unknown chaos kind"):
-        faults.FaultSpec(site="assign.dispatch", kind="meteor")
+        # the bad kind IS the test
+        faults.FaultSpec(site="assign.dispatch", kind="meteor")  # graftlint: disable=chaos-unknown-kind
     with pytest.raises(ValueError, match="outside"):
         faults.FaultSpec(site="assign.dispatch", p=1.5)
 

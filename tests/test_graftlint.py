@@ -224,6 +224,90 @@ def test_chaos_parity_is_clean(tmp_path):
     assert findings == []
 
 
+_MINI_FAULTS_WITH_KINDS = (
+    "KNOWN_SITES = frozenset({'assign.dispatch', 'polish.dispatch'})\n"
+    "KINDS = ('transient', 'stall')\n"
+    "def inject(site):\n"
+    "    pass\n"
+)
+
+
+def test_typod_chaos_kind_fires_both_directions(tmp_path):
+    """A typo'd kind in a test's spec dict arms a plan that tests nothing
+    (chaos-unknown-kind) AND leaves the real kind with no arming spec
+    anywhere (chaos-unused-kind) — both directions must report."""
+    findings = lint(tmp_path, {
+        "faults.py": _MINI_FAULTS_WITH_KINDS,
+        "plant.py": (
+            "import faults\n"
+            "def go():\n"
+            "    faults.inject('assign.dispatch')\n"
+            "    faults.inject('polish.dispatch')\n"
+        ),
+        "test_plan.py": (
+            "SPECS = [\n"
+            "    {'site': 'assign.dispatch', 'kind': 'transient'},\n"
+            "    {'site': 'polish.dispatch', 'kind': 'stal'},\n"  # misspelled
+            "]\n"
+        ),
+    })
+    assert rules_of(findings) == {"chaos-unknown-kind", "chaos-unused-kind"}
+    unknown = [f for f in findings if f.rule == "chaos-unknown-kind"]
+    assert len(unknown) == 1 and "'stal'" in unknown[0].message
+    unused = [f for f in findings if f.rule == "chaos-unused-kind"]
+    assert len(unused) == 1 and "'stall'" in unused[0].message
+    assert unused[0].path.endswith("faults.py")  # anchored at KINDS itself
+
+
+def test_chaos_kind_handler_comparisons_checked_but_not_arming(tmp_path):
+    """``spec.kind == X`` handler comparisons are validated against KINDS
+    (a typo'd handler branch is dead code) but do NOT count as arming the
+    kind — only spec literals / FaultSpec(kind=...) calls keep a kind
+    'used'."""
+    findings = lint(tmp_path, {
+        "faults.py": _MINI_FAULTS_WITH_KINDS,
+        "plant.py": (
+            "import faults\n"
+            "def go(spec):\n"
+            "    faults.inject('assign.dispatch')\n"
+            "    faults.inject('polish.dispatch')\n"
+            "    if spec.kind == 'transinet':\n"  # dead handler branch
+            "        pass\n"
+            "    if spec.kind in ('transient', 'stall'):\n"
+            "        pass\n"
+        ),
+        "test_plan.py": (
+            "import faults\n"
+            "SPECS = [{'site': 'assign.dispatch', 'kind': 'transient'}]\n"
+            "ALSO = faults.FaultSpec(site='polish.dispatch', kind='stall')\n"
+        ),
+    })
+    # the comparisons alone did not mark kinds used — the spec dict and
+    # the FaultSpec call did; only the typo'd handler comparison reports
+    assert rules_of(findings) == {"chaos-unknown-kind"}
+    (bad,) = findings
+    assert "'transinet'" in bad.message
+
+
+def test_chaos_kind_parity_is_clean(tmp_path):
+    findings = lint(tmp_path, {
+        "faults.py": _MINI_FAULTS_WITH_KINDS,
+        "plant.py": (
+            "import faults\n"
+            "def go():\n"
+            "    faults.inject('assign.dispatch')\n"
+            "    faults.inject('polish.dispatch')\n"
+        ),
+        "test_plan.py": (
+            "SPECS = [\n"
+            "    {'site': 'assign.dispatch', 'kind': 'transient'},\n"
+            "    {'site': 'polish.dispatch', 'kind': 'stall'},\n"
+            "]\n"
+        ),
+    })
+    assert findings == []
+
+
 # ---------------------------------------------------------------------------
 # rule family 4: config-field cross-check
 

@@ -1,5 +1,8 @@
 """IO layer: fastx round-trips, bucketing, layout, config."""
 
+import json
+import os
+
 import pytest
 
 from ont_tcrconsensus_tpu.io import bucketing, fastx, layout
@@ -111,6 +114,121 @@ def test_layout_manifest_corruption_tolerated(tmp_path, capsys):
 
     # empty file (fsync-less crash truncation) too
     open(lay.manifest_path, "w").close()
+    assert lay.completed_stages() == {}
+
+
+def _lib_with_artifact(tmp_path, content=b"TCR,Count\nregionA,3\n"):
+    lay = layout.init_library_dir("/x/barcode01.fastq.gz", tmp_path)
+    art = tmp_path / "barcode01" / "counts" / "umi_consensus_counts.csv"
+    art.write_bytes(content)
+    return lay, art
+
+
+def test_manifest_v2_records_checksums_and_verifies(tmp_path):
+    """mark_stage_done(artifacts=...) writes a v2 manifest whose entries
+    carry sha256 + byte size, and verify_stage passes in every mode on an
+    untouched artifact."""
+    lay, art = _lib_with_artifact(tmp_path)
+    lay.mark_stage_done("counts", artifacts=[art])
+
+    raw = json.loads(open(lay.manifest_path).read())
+    assert raw["version"] == layout.MANIFEST_VERSION
+    rel = os.path.relpath(art, lay.library_dir)
+    meta = raw["stages"]["counts"]["artifacts"][rel]
+    want_sha, want_bytes = layout.sha256_file(art)
+    assert meta == {"sha256": want_sha, "bytes": want_bytes}
+
+    for mode in layout.VERIFY_MODES:
+        ok, why = lay.verify_stage("counts", mode)
+        assert ok and why is None, (mode, why)
+    # an unmarked stage fails in every mode, including off
+    ok, why = lay.verify_stage("polish", "off")
+    assert not ok and "not marked done" in why
+    with pytest.raises(ValueError, match="verify_resume"):
+        lay.verify_stage("counts", "paranoid")
+
+
+def test_manifest_verify_catches_truncation_missing_and_bit_rot(tmp_path):
+    lay, art = _lib_with_artifact(tmp_path)
+    lay.mark_stage_done("counts", artifacts=[art])
+
+    # size-changing truncation: fast (and full) catch it; off trusts
+    original = art.read_bytes()
+    art.write_bytes(original[:-3])
+    assert lay.verify_stage("counts", "off") == (True, None)
+    ok, why = lay.verify_stage("counts", "fast")
+    assert not ok and "size" in why
+    assert not lay.verify_stage("counts", "full")[0]
+
+    # size-preserving bit rot: ONLY full's sha256 catches it
+    flipped = bytearray(original)
+    flipped[len(flipped) // 2] ^= 0x01
+    art.write_bytes(bytes(flipped))
+    assert lay.verify_stage("counts", "fast") == (True, None)
+    ok, why = lay.verify_stage("counts", "full")
+    assert not ok and "sha256" in why
+
+    # missing artifact: fast catches it
+    art.unlink()
+    ok, why = lay.verify_stage("counts", "fast")
+    assert not ok and "missing" in why
+
+
+def test_manifest_v1_read_path_and_v2_upgrade(tmp_path):
+    """v1 -> v2 migration: a flat {stage: time} manifest (pre-checksum
+    runs) still reads, its stages are unverifiable under fast/full (warn +
+    re-run semantics live in run.py), and marking a NEW stage on top
+    upgrades the file to v2 while keeping the v1 entries readable."""
+    lay, art = _lib_with_artifact(tmp_path)
+    with open(lay.manifest_path, "w") as fh:
+        json.dump({"round1_consensus": 1700000000.0}, fh)  # a v1 file
+
+    assert lay.stage_done("round1_consensus")
+    assert lay.completed_stages() == {"round1_consensus": 1700000000.0}
+    # off trusts the bare mark; fast/full refuse to trust it
+    assert lay.verify_stage("round1_consensus", "off") == (True, None)
+    for mode in ("fast", "full"):
+        ok, why = lay.verify_stage("round1_consensus", mode)
+        assert not ok and "unverifiable" in why
+
+    # marking on top migrates the file to v2 (mixed-version manifest)
+    lay.mark_stage_done("counts", artifacts=[art])
+    raw = json.loads(open(lay.manifest_path).read())
+    assert raw["version"] == layout.MANIFEST_VERSION
+    assert raw["stages"]["round1_consensus"]["artifacts"] is None  # still v1-era
+    assert raw["stages"]["counts"]["artifacts"]  # checksummed
+    # the v2-era stage verifies; the v1-era stage stays unverifiable
+    assert lay.verify_stage("counts", "full") == (True, None)
+    assert not lay.verify_stage("round1_consensus", "fast")[0]
+    assert set(lay.completed_stages()) == {"round1_consensus", "counts"}
+
+
+def test_manifest_malformed_v2_entry_dropped(tmp_path, capsys):
+    """One malformed stage entry (disk bit-flip inside valid JSON) drops
+    that entry with a warning instead of poisoning the whole manifest."""
+    lay, art = _lib_with_artifact(tmp_path)
+    with open(lay.manifest_path, "w") as fh:
+        json.dump({"version": 2, "stages": {
+            "round1_consensus": "not-a-dict",
+            "counts": {"t": 1700000000.0, "artifacts": None},
+        }}, fh)
+    assert set(lay.completed_stages()) == {"counts"}
+    assert "malformed" in capsys.readouterr().err
+    # v2 with a torn stages map reads as nothing done
+    with open(lay.manifest_path, "w") as fh:
+        json.dump({"version": 2, "stages": [1, 2]}, fh)
+    assert lay.completed_stages() == {}
+    assert "no valid 'stages'" in capsys.readouterr().err
+    # valid-JSON-but-garbage VALUES never crash (the never-crash contract
+    # covers bit rot inside the JSON too): v1 string time, v2 null time
+    with open(lay.manifest_path, "w") as fh:
+        json.dump({"counts": "x", "align": 1700000000.0}, fh)
+    assert set(lay.completed_stages()) == {"align"}
+    assert "malformed" in capsys.readouterr().err
+    with open(lay.manifest_path, "w") as fh:
+        json.dump({"version": 2, "stages": {
+            "counts": {"t": None, "artifacts": None},
+        }}, fh)
     assert lay.completed_stages() == {}
 
 

@@ -168,7 +168,8 @@ def test_faults_unknown_site_or_kind_rejected():
     with pytest.raises(ValueError, match="unknown chaos site"):
         faults.arm([{"site": "nope.nope"}])
     with pytest.raises(ValueError, match="unknown chaos kind"):
-        faults.arm([{"site": "polish.dispatch", "kind": "wat"}])
+        # the bad kind IS the test
+        faults.arm([{"site": "polish.dispatch", "kind": "wat"}])  # graftlint: disable=chaos-unknown-kind
 
 
 def test_faults_oom_and_error_kinds():

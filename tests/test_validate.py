@@ -233,3 +233,112 @@ def test_validate_cli_flags_config_and_missing_inputs(tmp_path, capsys):
     cfg_path, _ = _write_config(tmp_path, reference_file=str(tmp_path / "nope.fa"))
     assert cli.main([str(cfg_path), "--validate"]) == 1
     assert "unreadable" in capsys.readouterr().out
+
+
+def _mark_counts_done(tmp_path, content=b"TCR,Count\nregionA,3\n"):
+    """A fake completed library under <fastq_pass>/nano_tcr/barcode01."""
+    from ont_tcrconsensus_tpu.io import layout
+
+    nano = tmp_path / "fastq_pass" / "nano_tcr"
+    nano.mkdir(parents=True, exist_ok=True)
+    lay = layout.init_library_dir("/x/barcode01.fastq.gz", nano, resume=True)
+    art = nano / "barcode01" / "counts" / "umi_consensus_counts.csv"
+    art.write_bytes(content)
+    lay.mark_stage_done("counts", artifacts=[art])
+    return lay, art
+
+
+def test_validate_cli_audits_clean_v2_manifest(tmp_path, capsys):
+    from ont_tcrconsensus_tpu.pipeline import cli
+
+    cfg_path, _ = _write_config(tmp_path)
+    _mark_counts_done(tmp_path)
+    assert cli.main([str(cfg_path), "--validate"]) == 0
+    out = capsys.readouterr().out
+    assert "(v2): 1 stage(s), 1 verified" in out
+    assert "validate: OK" in out
+
+
+def test_validate_cli_flags_checksum_mismatch(tmp_path, capsys):
+    """The dry-run twin of verify_resume=full: a size-preserving byte flip
+    on a completed artifact is a PROBLEM, reported without starting a
+    run."""
+    from ont_tcrconsensus_tpu.pipeline import cli
+
+    cfg_path, _ = _write_config(tmp_path)
+    _, art = _mark_counts_done(tmp_path)
+    data = bytearray(art.read_bytes())
+    data[len(data) // 2] ^= 0x01
+    art.write_bytes(bytes(data))  # same size: only sha256 can see this
+    assert cli.main([str(cfg_path), "--validate"]) == 1
+    out = capsys.readouterr().out
+    assert "PROBLEM" in out and "sha256" in out
+    assert "failed artifact verification" in out
+
+
+def test_validate_cli_reports_torn_and_v1_manifests(tmp_path, capsys):
+    from ont_tcrconsensus_tpu.io import validate as vmod
+    from ont_tcrconsensus_tpu.pipeline import cli
+
+    cfg_path, _ = _write_config(tmp_path)
+    lay, _ = _mark_counts_done(tmp_path)
+
+    # v1 (flat) manifest: informational, NOT an error — resume under
+    # fast/full warns and re-runs; the operator just learns it's legacy
+    lay_path = tmp_path / "fastq_pass" / "nano_tcr" / "barcode01" / \
+        "stage_manifest.json"
+    lay_path.write_text(json.dumps({"counts": 1700000000.0}))
+    assert cli.main([str(cfg_path), "--validate"]) == 0
+    assert "v1 (no checksums" in capsys.readouterr().out
+
+    # torn manifest: a real problem (crash mid-write / disk fault)
+    lay_path.write_text('{"version": 2, "stages": {"coun')
+    assert cli.main([str(cfg_path), "--validate"]) == 1
+    out = capsys.readouterr().out
+    assert "TORN" in out and "PROBLEM" in out
+
+    # a v2 header over a broken body is TORN, not "v2 with 0 clean stages"
+    lay_path.write_text(json.dumps({"version": 2, "stages": [1, 2]}))
+    assert cli.main([str(cfg_path), "--validate"]) == 1
+    assert "TORN" in capsys.readouterr().out
+
+    # a malformed individual v2 entry is reported, not silently undercounted
+    lay_path.write_text(json.dumps({"version": 2, "stages": {
+        "counts": {"t": None, "artifacts": None},
+    }}))
+    assert cli.main([str(cfg_path), "--validate"]) == 1
+    assert "malformed manifest entry" in capsys.readouterr().out
+
+    # ... and the identical damage inside a v1 manifest is flagged the same
+    # way, not laundered into "v1, 0 stages, looks clean"
+    lay_path.write_text(json.dumps({"counts": "not-a-time"}))
+    assert cli.main([str(cfg_path), "--validate"]) == 1
+    assert "malformed manifest entry" in capsys.readouterr().out
+
+    # the scan API classifies all three shapes directly
+    lay_path.write_text(json.dumps({"counts": 1700000000.0}))
+    (report,) = vmod.scan_manifests(str(tmp_path / "fastq_pass"))
+    assert report["status"] == "v1"
+    assert report["stages"] == {"counts": "v1 entry — no checksums recorded"}
+
+
+def test_validate_cli_mixed_version_manifest_is_not_an_error(tmp_path, capsys):
+    """A v1 workdir resumed once holds a MIGRATED v2 manifest whose v1-era
+    entries carry artifacts: null — legacy, not damage: --validate must
+    stay exit 0 (same verdict as a pure-v1 manifest), not report 'failed
+    artifact verification' on an uncorrupted workdir."""
+    from ont_tcrconsensus_tpu.pipeline import cli
+
+    cfg_path, _ = _write_config(tmp_path)
+    lay, art = _mark_counts_done(tmp_path)
+    mpath = tmp_path / "fastq_pass" / "nano_tcr" / "barcode01" / \
+        "stage_manifest.json"
+    # rebuild the exact migration state: a v1 file with a legacy stage,
+    # re-marked on top (mark_stage_done migrates to v2, artifacts: null
+    # for the old entry)
+    mpath.write_text(json.dumps({"align": 1700000000.0}))
+    lay.mark_stage_done("counts", artifacts=[art])
+    assert json.loads(mpath.read_text())["version"] == 2
+    assert cli.main([str(cfg_path), "--validate"]) == 0
+    out = capsys.readouterr().out
+    assert "v1-era entry" in out and "validate: OK" in out

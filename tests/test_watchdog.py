@@ -1,0 +1,324 @@
+"""Unit tests for the liveness watchdog (robustness/watchdog.py).
+
+Fast, pipeline-free coverage of the pieces the chaos e2e scenarios in
+test_chaos.py compose: deadline auto-scaling math at tiny/huge workload
+sizes, soft-deadline stall reporting (event + stack dump), hard-deadline
+cancellation delivering :class:`StageTimeout` into the stalled thread,
+heartbeat-driven deadline resets, and the disarmed fast path. Every
+deadline here is sub-second so the whole file stays well inside the
+tier-1 budget.
+"""
+
+import threading
+import time
+
+import pytest
+
+from ont_tcrconsensus_tpu.robustness import retry, watchdog
+
+#: safety cap on the tests' own simulated wedges: reached only when the
+#: watchdog fails to cancel, so the suite can't hang on a regression
+_WEDGE_CAP_S = 30.0
+
+
+@pytest.fixture(autouse=True)
+def _clean_watchdog_state():
+    retry.recorder().reset()
+    yield
+    watchdog.deactivate()
+    retry.recorder().reset()
+
+
+def _events(site: str) -> list[dict]:
+    return [e for e in retry.recorder().events if e["site"] == site]
+
+
+# --- deadline auto-scaling math ---------------------------------------------
+
+
+def test_scaled_timeout_tiny_workloads_keep_full_base():
+    """Up to units_per_base units the base is the deadline: fixed overhead
+    (compiles, warmup) dominates tiny workloads, so they must not get a
+    proportionally tiny — spuriously firing — deadline."""
+    assert watchdog.scaled_timeout(60.0, 0) == 60.0
+    assert watchdog.scaled_timeout(60.0, 1) == 60.0
+    assert watchdog.scaled_timeout(60.0, watchdog.UNITS_PER_BASE) == 60.0
+
+
+def test_scaled_timeout_huge_workloads_scale_linearly():
+    base = 60.0
+    upb = watchdog.UNITS_PER_BASE
+    assert watchdog.scaled_timeout(base, 10 * upb) == pytest.approx(600.0)
+    assert watchdog.scaled_timeout(base, 1000 * upb) == pytest.approx(60000.0)
+    # just past the knee: scaling is continuous, not a step
+    assert watchdog.scaled_timeout(base, upb + 1) == pytest.approx(
+        base * (upb + 1) / upb
+    )
+
+
+def test_scaled_timeout_monotone_and_never_below_base():
+    prev = 0.0
+    for units in (0, 1, 10, 999, 1000, 1001, 5000, 10**7):
+        t = watchdog.scaled_timeout(5.0, units)
+        assert t >= 5.0
+        assert t >= prev
+        prev = t
+
+
+def test_scaled_timeout_custom_units_per_base():
+    assert watchdog.scaled_timeout(10.0, 8, units_per_base=4) == 20.0
+    assert watchdog.scaled_timeout(10.0, 3, units_per_base=4) == 10.0
+
+
+# --- StageTimeout / classifier contract -------------------------------------
+
+
+def test_stage_timeout_classified_transient():
+    """The watchdog's cancel exception re-enters the retry path: both the
+    isinstance and the DEADLINE_EXCEEDED message marker say transient —
+    and the argument-less construction (all PyThreadState_SetAsyncExc can
+    deliver) still carries the marker."""
+    exc = watchdog.StageTimeout()
+    assert "DEADLINE_EXCEEDED" in str(exc)
+    assert retry.classify(exc) == "transient"
+    assert retry.classify(watchdog.StageTimeout("custom message")) == "transient"
+
+
+# --- disarmed fast path ------------------------------------------------------
+
+
+def test_disarmed_heartbeat_and_guard_are_noops():
+    assert not watchdog.active()
+    watchdog.heartbeat("anywhere")  # must not raise
+    with watchdog.guard("stage", units=10**9):
+        watchdog.heartbeat("inside")
+    assert watchdog.active_deadline_s() is None
+    assert retry.recorder().events == []
+
+
+# --- armed behavior ----------------------------------------------------------
+
+
+def test_soft_deadline_emits_stall_event_and_stack_dump(tmp_path):
+    log = tmp_path / "watchdog.log"
+    wd = watchdog.activate(watchdog.Watchdog(
+        base_timeout_s=10.0, soft_fraction=0.02, tick_s=0.02,
+        log_path=str(log),
+    ))
+    wd.start()
+    try:
+        with watchdog.guard("polish", units=0):
+            watchdog.heartbeat("polish.chunk")
+            time.sleep(0.5)  # soft deadline (0.2s) expires; hard (10s) not
+    finally:
+        wd.stop()
+    stalls = _events("watchdog.stall")
+    assert len(stalls) == 1  # soft fires ONCE per stall, not per tick
+    ev = stalls[0]
+    assert ev["outcome"] == "stall_detected"
+    assert ev["classification"] == "stall"
+    assert ev["detail"]["stage"] == "polish"
+    assert ev["detail"]["last_heartbeat_site"] == "polish.chunk"
+    assert ev["detail"]["stalled_s"] >= ev["detail"]["soft_deadline_s"]
+    # the all-thread faulthandler dump landed in the library log
+    dump = log.read_text()
+    assert "dumping all thread stacks" in dump
+    assert "Thread" in dump or "Current thread" in dump
+
+
+def test_hard_deadline_cancels_stalled_thread_with_stage_timeout():
+    wd = watchdog.activate(watchdog.Watchdog(base_timeout_s=0.3, tick_s=0.02))
+    wd.start()
+    try:
+        with pytest.raises(watchdog.StageTimeout):
+            with watchdog.guard("wedged"):
+                deadline = time.monotonic() + _WEDGE_CAP_S
+                while time.monotonic() < deadline:  # interruptible wedge
+                    time.sleep(0.01)
+                raise AssertionError("watchdog never cancelled the stall")
+    finally:
+        wd.stop()
+    outcomes = [e["outcome"] for e in _events("watchdog.stall")]
+    assert "hard_cancel" in outcomes
+    assert "stall_detected" in outcomes  # soft fired on the way to hard
+
+
+def test_soft_report_rearms_after_recovery():
+    """A stall that RECOVERS via heartbeats (never reaching the hard
+    deadline) must be diagnosed again if the stage stalls a second time —
+    the soft report re-arms on every heartbeat, not only at hard cancel."""
+    wd = watchdog.activate(watchdog.Watchdog(
+        base_timeout_s=10.0, soft_fraction=0.02, tick_s=0.02,
+    ))
+    wd.start()
+    try:
+        with watchdog.guard("flappy"):
+            time.sleep(0.4)                    # stall 1: past soft (0.2s)
+            watchdog.heartbeat("flappy.tick")  # recovery re-arms the report
+            time.sleep(0.4)                    # stall 2: must report again
+    finally:
+        wd.stop()
+    outcomes = [e["outcome"] for e in _events("watchdog.stall")]
+    assert outcomes.count("stall_detected") == 2
+    assert "hard_cancel" not in outcomes
+
+
+def test_heartbeats_reset_the_deadline():
+    """Steady progress never fires, regardless of total stage length: 0.6s
+    of work under a 0.25s hard deadline, heartbeating every 0.05s."""
+    wd = watchdog.activate(watchdog.Watchdog(base_timeout_s=0.25, tick_s=0.02))
+    wd.start()
+    try:
+        with watchdog.guard("steady"):
+            for _ in range(12):
+                watchdog.heartbeat("steady.tick")
+                time.sleep(0.05)
+    finally:
+        wd.stop()
+    assert _events("watchdog.stall") == []
+
+
+def test_cancelled_stage_retry_gets_fresh_deadline():
+    """After a hard cancel the stall clock resets: a retry attempt inside
+    the SAME guard scope that then makes steady progress is not cancelled
+    again, and a SECOND stall is detected again (soft re-arms)."""
+    wd = watchdog.activate(watchdog.Watchdog(base_timeout_s=0.3, tick_s=0.02))
+    wd.start()
+    cancels = 0
+    try:
+        with watchdog.guard("retryable"):
+            for _attempt in range(3):
+                try:
+                    deadline = time.monotonic() + _WEDGE_CAP_S
+                    while time.monotonic() < deadline:
+                        time.sleep(0.01)
+                except watchdog.StageTimeout:
+                    cancels += 1
+                    continue
+    finally:
+        wd.stop()
+    assert cancels == 3
+    outcomes = [e["outcome"] for e in _events("watchdog.stall")]
+    assert outcomes.count("hard_cancel") == 3
+    assert outcomes.count("stall_detected") == 3  # soft re-armed each time
+
+
+def test_guard_exit_is_race_free_with_cancel():
+    """A guard that exits right as the deadline expires must never leak a
+    StageTimeout into code OUTSIDE the scope: the cancel is sent under the
+    registry lock only while the scope is still registered, and a queued
+    undelivered exception is cleared at guard exit."""
+    wd = watchdog.activate(watchdog.Watchdog(base_timeout_s=0.05, tick_s=0.01))
+    wd.start()
+    try:
+        for _ in range(20):
+            try:
+                with watchdog.guard("short"):
+                    time.sleep(0.06)  # straddles the deadline
+            except watchdog.StageTimeout:
+                pass  # delivered inside the scope: fine
+            # 10ms of post-scope work: a leaked async exc would land here
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 0.01:
+                pass
+    finally:
+        wd.stop()
+
+
+def test_worker_thread_guard_is_independent_of_main_thread():
+    """Guards are per-thread (overlap.py workers register their own): a
+    stalled worker is cancelled while the main thread's scope is
+    untouched."""
+    wd = watchdog.activate(watchdog.Watchdog(base_timeout_s=0.3, tick_s=0.02))
+    wd.start()
+    seen: dict = {}
+
+    def worker():
+        try:
+            with watchdog.guard("overlap.qc"):
+                deadline = time.monotonic() + _WEDGE_CAP_S
+                while time.monotonic() < deadline:
+                    time.sleep(0.01)
+        except watchdog.StageTimeout as exc:
+            seen["exc"] = exc
+
+    try:
+        with watchdog.guard("main"):
+            t = threading.Thread(target=worker)
+            t.start()
+            while t.is_alive():
+                watchdog.heartbeat("main.loop")  # main makes progress
+                time.sleep(0.02)
+            t.join()
+    finally:
+        wd.stop()
+    assert isinstance(seen.get("exc"), watchdog.StageTimeout)
+    cancelled = [e for e in _events("watchdog.stall")
+                 if e["outcome"] == "hard_cancel"]
+    assert [e["detail"]["stage"] for e in cancelled] == ["overlap.qc"]
+
+
+def test_cli_installs_sigquit_stack_dump():
+    """The CLI registers SIGQUIT -> all-thread faulthandler dump at startup
+    (ISSUE 5 satellite): a wedged production run is diagnosable with
+    ``kill -QUIT`` even when the watchdog is disarmed."""
+    import faulthandler
+    import signal
+
+    from ont_tcrconsensus_tpu.pipeline import cli
+
+    if not hasattr(signal, "SIGQUIT"):
+        pytest.skip("platform has no SIGQUIT")
+    faulthandler.unregister(signal.SIGQUIT)  # a clean slate
+    cli._install_stack_dump_signal()
+    try:
+        assert faulthandler.unregister(signal.SIGQUIT)  # it WAS registered
+    finally:
+        # never leave a half-registered handler behind for other tests
+        faulthandler.unregister(signal.SIGQUIT)
+
+
+def test_active_deadline_reflects_scaled_units():
+    wd = watchdog.activate(watchdog.Watchdog(base_timeout_s=2.0))
+    # no monitor needed: deadline introspection is registry-only
+    with watchdog.guard("big", units=watchdog.UNITS_PER_BASE * 5):
+        assert watchdog.active_deadline_s() == pytest.approx(10.0)
+    assert watchdog.active_deadline_s() is None
+
+
+def test_early_return_disarms_watchdog(tmp_path):
+    """Every exit path of run_with_config must tear down the process-global
+    watchdog — the only_run_reference_self_homology early return used to
+    leak an armed monitor into the embedder's next (even unarmed) run."""
+    from ont_tcrconsensus_tpu.io import fastx, simulator
+    from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+    from ont_tcrconsensus_tpu.pipeline.run import run_with_config
+
+    lib = simulator.simulate_library(seed=3, num_regions=2,
+                                     molecules_per_region=(1, 1),
+                                     reads_per_molecule=(1, 1))
+    fastx.write_fasta(tmp_path / "reference.fa", lib.reference.items())
+    (tmp_path / "fastq_pass").mkdir()
+    cfg = RunConfig.from_dict({
+        "reference_file": str(tmp_path / "reference.fa"),
+        "fastq_pass_dir": str(tmp_path / "fastq_pass"),
+        "stage_timeout_s": 60,
+        "only_run_reference_self_homology": True,
+    })
+    assert run_with_config(cfg) == {}
+    assert not watchdog.active(), "early return leaked an armed watchdog"
+
+
+def test_stall_drill_refuses_deadline_past_safety_cap():
+    """A stall/hang drill under a hard deadline beyond STALL_CAP_S would
+    end BEFORE the watchdog fires and wrongly diagnose it as disarmed —
+    the injection must refuse loudly up front instead (and instantly:
+    no sleep happens on this path)."""
+    from ont_tcrconsensus_tpu.robustness import faults
+
+    watchdog.activate(watchdog.Watchdog(base_timeout_s=faults.STALL_CAP_S * 2))
+    with watchdog.guard("polish"):
+        with pytest.raises(RuntimeError, match="safety cap"):
+            faults._stall_until_cancelled("hang", "polish.dispatch")
+        with pytest.raises(RuntimeError, match="safety cap"):
+            faults._stall_until_cancelled("stall", "polish.dispatch")

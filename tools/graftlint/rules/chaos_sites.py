@@ -1,20 +1,29 @@
-"""chaos-site cross-check: planted literals vs ``faults.KNOWN_SITES``.
+"""chaos-site AND chaos-kind cross-checks vs the ``faults`` registry.
 
-The chaos registry fails fast on unknown sites when ARMING a plan, but a
-typo in a *planted* ``faults.inject("...")`` literal is silent forever:
-the site never matches any spec and the injection point is dead. The
-inverse drift — a ``KNOWN_SITES`` entry whose plant was refactored away —
-leaves chaos plans that "pass" without testing anything. Both directions
-are cross-file properties, checked here:
+The chaos registry fails fast on unknown sites/kinds when ARMING a plan,
+but a typo in a *planted* ``faults.inject("...")`` literal — or in a
+``kind`` literal inside a test's spec dict or a handler comparison — is
+silent forever: the site/kind never matches and the injection point (or
+the scenario arming it) is dead. The inverse drift — a ``KNOWN_SITES`` /
+``KINDS`` entry nothing plants or arms anymore — leaves chaos plans that
+"pass" without testing anything. All four directions are cross-file
+properties, checked here:
 
 - ``chaos-unknown-site``   — an ``inject``/``mutate_input``/``tear_write``
-  site literal that is not in ``KNOWN_SITES``;
+  /``corrupt_artifact`` site literal that is not in ``KNOWN_SITES``;
 - ``chaos-unplanted-site`` — a ``KNOWN_SITES`` entry never planted in the
-  scanned tree (reported at the entry's own line in faults.py).
+  scanned tree (reported at the entry's own line in faults.py);
+- ``chaos-unknown-kind``   — a kind literal (a ``{"site": ..., "kind": X}``
+  spec dict, a ``FaultSpec(kind=X)`` call, or a ``spec.kind == X`` /
+  ``spec.kind in (...)`` handler comparison) not in ``KINDS``;
+- ``chaos-unused-kind``    — a ``KINDS`` entry no spec literal in the
+  scanned tree ever arms (reported at the KINDS tuple's line) — a fault
+  family the chaos suite silently stopped exercising.
 
-``KNOWN_SITES`` is read from the scanned files themselves (the
-``KNOWN_SITES = frozenset({...})`` assignment), so fixture trees exercise
-the same path; with no definition in scope both checks no-op.
+``KNOWN_SITES`` / ``KINDS`` are read from the scanned files themselves
+(the ``KNOWN_SITES = frozenset({...})`` / ``KINDS = (...)`` assignments),
+so fixture trees exercise the same path; with no definition in scope the
+corresponding checks no-op.
 """
 
 from __future__ import annotations
@@ -25,13 +34,19 @@ from typing import Iterator
 from tools.graftlint.core import FileCtx, Finding, Project
 
 RULES = {
-    "chaos-unknown-site": "faults.inject/mutate_input/tear_write site literal "
-                          "not in faults.KNOWN_SITES (dead injection point)",
+    "chaos-unknown-site": "faults.inject/mutate_input/tear_write/"
+                          "corrupt_artifact site literal not in "
+                          "faults.KNOWN_SITES (dead injection point)",
     "chaos-unplanted-site": "KNOWN_SITES entry not planted at any injection "
                             "point in the scanned tree",
+    "chaos-unknown-kind": "chaos kind literal (spec dict / FaultSpec kwarg / "
+                          "handler comparison) not in faults.KINDS "
+                          "(dead fault spec)",
+    "chaos-unused-kind": "KINDS entry never armed by any spec literal in "
+                         "the scanned tree (unexercised fault family)",
 }
 
-_PLANT_FUNCS = {"inject", "mutate_input", "tear_write"}
+_PLANT_FUNCS = {"inject", "mutate_input", "tear_write", "corrupt_artifact"}
 
 
 def known_sites(project: Project) -> dict[str, tuple[str, int]]:
@@ -75,23 +90,100 @@ def planted_sites(project: Project) -> dict[str, list[tuple[str, int]]]:
     return plants
 
 
+def known_kinds(project: Project) -> dict[str, tuple[str, int]]:
+    """{kind: (path, line)} from every ``KINDS = (...)`` assignment in the
+    scanned files (tuple/set/list of string constants)."""
+    kinds: dict[str, tuple[str, int]] = {}
+    for ctx in project.files:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "KINDS"
+                for t in node.targets
+            )):
+                continue
+            for const in ast.walk(node.value):
+                if isinstance(const, ast.Constant) and isinstance(const.value, str):
+                    kinds[const.value] = (ctx.path, const.lineno)
+    return kinds
+
+
+def _kind_literals(ctx: FileCtx) -> Iterator[tuple[ast.AST, str, bool]]:
+    """(node, kind literal, is_spec) per kind usage in one file.
+
+    ``is_spec`` usages ARM a fault (a ``{"site": ..., "kind": X}`` dict or
+    a ``FaultSpec(kind=X)`` call) and count for the unused-kind direction;
+    handler comparisons (``spec.kind == X`` / ``spec.kind in (...)``) are
+    checked against KINDS but do not make a kind "used".
+    """
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Dict):
+            keys = [k.value for k in node.keys
+                    if isinstance(k, ast.Constant)]
+            if "kind" in keys and "site" in keys:
+                for k, v in zip(node.keys, node.values):
+                    if (isinstance(k, ast.Constant) and k.value == "kind"
+                            and isinstance(v, ast.Constant)
+                            and isinstance(v.value, str)):
+                        yield v, v.value, True
+        elif isinstance(node, ast.Call):
+            func = node.func
+            name = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else None
+            )
+            if name == "FaultSpec":
+                for kw in node.keywords:
+                    if (kw.arg == "kind" and isinstance(kw.value, ast.Constant)
+                            and isinstance(kw.value.value, str)):
+                        yield kw.value, kw.value.value, True
+        elif isinstance(node, ast.Compare):
+            left = node.left
+            if not (isinstance(left, ast.Attribute) and left.attr == "kind"):
+                continue
+            for comp in node.comparators:
+                consts = ([comp] if isinstance(comp, ast.Constant)
+                          else list(getattr(comp, "elts", ())))
+                for c in consts:
+                    if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                        yield c, c.value, False
+
+
 def check(project: Project) -> Iterator[Finding]:
     known = known_sites(project)
-    if not known:
-        return  # no faults registry in the scanned set: nothing to check
-    plants = planted_sites(project)
-    for ctx in project.files:
-        for node, site in _plant_calls(ctx):
-            if site not in known:
+    if known:
+        plants = planted_sites(project)
+        for ctx in project.files:
+            for node, site in _plant_calls(ctx):
+                if site not in known:
+                    yield Finding(
+                        ctx.path, node.lineno, node.col_offset, "chaos-unknown-site",
+                        f"site {site!r} is not in faults.KNOWN_SITES — this "
+                        "injection point can never fire (typo?)",
+                    )
+        for site, (path, line) in sorted(known.items()):
+            if site not in plants:
                 yield Finding(
-                    ctx.path, node.lineno, node.col_offset, "chaos-unknown-site",
-                    f"site {site!r} is not in faults.KNOWN_SITES — this "
-                    "injection point can never fire (typo?)",
+                    path, line, 0, "chaos-unplanted-site",
+                    f"KNOWN_SITES entry {site!r} is planted nowhere in the "
+                    "scanned tree — chaos plans arming it test nothing",
                 )
-    for site, (path, line) in sorted(known.items()):
-        if site not in plants:
-            yield Finding(
-                path, line, 0, "chaos-unplanted-site",
-                f"KNOWN_SITES entry {site!r} is planted nowhere in the "
-                "scanned tree — chaos plans arming it test nothing",
-            )
+    kinds = known_kinds(project)
+    if kinds:
+        used: set[str] = set()
+        for ctx in project.files:
+            for node, kind, is_spec in _kind_literals(ctx):
+                if is_spec:
+                    used.add(kind)
+                if kind not in kinds:
+                    yield Finding(
+                        ctx.path, node.lineno, node.col_offset,
+                        "chaos-unknown-kind",
+                        f"kind {kind!r} is not in faults.KINDS — this "
+                        "spec/handler can never fire (typo?)",
+                    )
+        for kind, (path, line) in sorted(kinds.items()):
+            if kind not in used:
+                yield Finding(
+                    path, line, 0, "chaos-unused-kind",
+                    f"KINDS entry {kind!r} is armed by no spec literal in "
+                    "the scanned tree — this fault family is untested",
+                )
