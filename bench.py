@@ -165,6 +165,29 @@ def assignment_accuracy(root: str, lib) -> float:
     return ok / n if n else 0.0
 
 
+def read_telemetry_summary(root: str) -> dict | None:
+    """Compact telemetry roll-up for the bench JSON line: per-site dispatch
+    counts + host-gap/block totals, compile count/seconds, HBM high-water
+    and peak host RSS — the numbers ROADMAP items 1 and 3 are blocked on,
+    committed with every capture (nano_tcr/telemetry.json, obs/report.py)."""
+    path = os.path.join(root, "fastq_pass", "nano_tcr", "telemetry.json")
+    try:
+        with open(path) as fh:
+            tele = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    gauges = tele.get("gauges", {})
+    return {
+        "dispatch": tele.get("dispatch", {}),
+        "compile": {
+            "count": tele.get("compile", {}).get("count", 0),
+            "seconds": tele.get("compile", {}).get("seconds", 0.0),
+        },
+        "hbm_high_water_bytes": gauges.get("device.hbm_bytes_in_use"),
+        "peak_host_rss_bytes": gauges.get("host.rss_bytes"),
+    }
+
+
 def read_stage_timing(root: str) -> dict[str, float]:
     import glob
 
@@ -273,6 +296,11 @@ def main():
         print(f"bench: count diffs (got, want): {diff}", file=sys.stderr)
     print(f"bench: stage timing {timing}", file=sys.stderr)
     emit_extra = {"n_reads": n_reads, "counts_exact": counts_ok}
+    telemetry = read_telemetry_summary(root)
+    if telemetry is not None:
+        # dispatch-tax + recompile + memory HWM summary of the TIMED run
+        # (warm process: compile count ~0 is the ROADMAP-3 success signal)
+        emit_extra["telemetry"] = telemetry
     breakdown_path = os.environ.get("BENCH_BREAKDOWN")
     if breakdown_path:
         import jax

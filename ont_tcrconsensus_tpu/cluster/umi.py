@@ -38,6 +38,7 @@ import dataclasses
 
 import numpy as np
 
+from ont_tcrconsensus_tpu.obs import device as obs_device
 from ont_tcrconsensus_tpu.ops import edit_distance, encode, sketch
 
 
@@ -279,9 +280,14 @@ def _full_identities(codes, lens, mesh=None):
             [codes, np.zeros((U_pad - U, codes.shape[1]), codes.dtype)]
         )
         lens = np.concatenate([lens, np.zeros(U_pad - U, lens.dtype)])
-    d = np.asarray(
-        edit_distance.many_vs_many_dovetail_auto(codes, lens, codes, lens, mesh=mesh)
-    ).astype(np.float32)[:U, :U]
+    # the blocking readback is the stage's device wait: time it under the
+    # umi.distance site (credits the enclosing cluster.batched_dispatch
+    # frame when the batched pass drives this)
+    d = np.asarray(obs_device.timed_get(
+        "umi.distance",
+        edit_distance.many_vs_many_dovetail_auto(codes, lens, codes, lens,
+                                                 mesh=mesh),
+    )).astype(np.float32)[:U, :U]
     longest = np.maximum(lens[:U, None], lens[None, :U]).astype(np.float32)
     ident = 1.0 - d / np.maximum(longest, 1.0)
     cols = np.arange(U - 1)[None, :]
@@ -344,12 +350,13 @@ def _neighbor_identities(codes, lens, shortlist_k, kmer_k, pair_batch, mesh=None
     ident = np.zeros(n_padded, dtype=np.float32)
     for s in range(0, n_padded, chunk):
         sl = slice(s, s + chunk)
-        d = np.asarray(
+        d = np.asarray(obs_device.timed_get(
+            "umi.distance",
             edit_distance.pairwise_dovetail_auto(
                 codes[qi[sl]], lens[qi[sl]], codes[ti[sl]], lens[ti[sl]],
                 mesh=mesh,
-            )
-        ).astype(np.float32)
+            ),
+        )).astype(np.float32)
         longest = np.maximum(lens[qi[sl]], lens[ti[sl]]).astype(np.float32)
         ident[sl] = np.where(longest > 0, 1.0 - d / np.maximum(longest, 1.0), 0.0)
     ident = ident[:n_pairs].reshape(U, K)

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ont_tcrconsensus_tpu.obs import device as obs_device
 from ont_tcrconsensus_tpu.parallel.mesh import mesh_data_size
 
 NUM_CLASSES = 5
@@ -443,7 +444,10 @@ def make_pipeline_polisher(params, band_width: int | None = None,
                 is_rev=jnp.asarray(strands) if wants_v4 else None,
                 bf16=bf16,
             )
-        pred, conf, depth, ins_pred, ins_conf = jax.device_get(out)
+        # blocked seconds credit the enclosing polish.dispatch frame
+        pred, conf, depth, ins_pred, ins_conf = obs_device.timed_get(
+            "polisher.get", out
+        )
         if use_low:
             # the depth-2 specialist's predictions replace the main
             # model's ONLY on exactly-low_depth clusters (blast-id
@@ -452,7 +456,7 @@ def make_pipeline_polisher(params, band_width: int | None = None,
             # recovers a real fraction; depth>=3 vote already passes, so
             # the pass cannot touch any other cluster)
             (pred_l, conf_l, _depth_l, ins_pred_l,
-             ins_conf_l) = jax.device_get(out_low)
+             ins_conf_l) = obs_device.timed_get("polisher.get", out_low)
             m = low_mask[:, None]
             pred = np.where(m, pred_l, pred)
             conf = np.where(m, conf_l, conf)

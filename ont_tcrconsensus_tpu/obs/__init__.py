@@ -1,0 +1,83 @@
+"""Unified telemetry layer: metrics, traces, and device instrumentation.
+
+The observability fragments that grew alongside the pipeline —
+``stage_timing.tsv``, ``robustness_report.json``, watchdog logs, the
+optional whole-run jax.profiler trace — cannot answer the questions the
+perf and service-mode work is blocked on: where the round1_polish
+dispatch/sync tax actually goes, whether tenant-to-tenant traffic
+recompiles, and what the HBM high-water / peak host RSS are at scale.
+This package is the one instrumentation layer behind all of them:
+
+- :mod:`.metrics` — a process-wide registry of counters / high-water
+  gauges / histograms plus per-dispatch-site and per-compile aggregates,
+  behind the same one-module-attr-check-when-disarmed discipline as
+  ``faults.inject`` and ``watchdog.heartbeat``.
+- :mod:`.trace`   — span/instant API emitting a Chrome-trace-format
+  ``logs/trace.json`` (thread-named rows for the main loop, overlap
+  workers and the watchdog monitor; instant events for retries, chaos
+  injections, stalls, contract violations and quarantine hits).
+  :class:`~ont_tcrconsensus_tpu.qc.timing.StageTimer` measures THROUGH
+  these spans, so the timing table and the trace derive from one clock
+  read and cannot disagree.
+- :mod:`.device`  — dispatch-site host-gap vs ``block_until_ready``
+  split, the ``jax.monitoring`` recompile audit attributing every XLA
+  compile to the active stage/shape-bucket, and the HBM / host-RSS
+  high-water sampler.
+- :mod:`.report`  — the per-run ``telemetry.json`` writer and the
+  ``tcr-consensus-tpu --report`` renderer (reads committed artifacts
+  only; never imports jax).
+
+Config: ``telemetry: off|on|full`` (pipeline/config.py). ``on`` (default)
+arms the metrics registry + compile audit and writes ``telemetry.json``;
+``full`` additionally arms the trace collector (``logs/trace.json``) and
+the memory sampler; ``off`` disarms everything — the planted call sites
+reduce to one module-attribute check.
+
+Every metric/span/dispatch-site name literal planted in the tree must be
+an entry of :data:`KNOWN_SITES`, and every entry must be planted
+somewhere — both directions are enforced by the graftlint ``obs-sites``
+rule (tools/graftlint/rules/obs_sites.py), mirroring the chaos-site
+cross-check.
+"""
+
+from __future__ import annotations
+
+# The site vocabulary. Defined under its own name (OBS_SITES) so the
+# graftlint chaos-site rule — which collects string constants from every
+# ``KNOWN_SITES = ...`` assignment in the scanned tree — does not merge
+# these into the chaos registry; the public alias below keeps the
+# ``obs.KNOWN_SITES`` API symmetric with ``faults.KNOWN_SITES``.
+OBS_SITES = frozenset({
+    # --- stage spans (qc/timing.StageTimer -> trace.span) ---
+    "round1_fused_assign",
+    "round1_error_profile",
+    "write_region_fastas",
+    "round1_umi_records",
+    "round1_umi_cluster",
+    "round1_polish",
+    "round2_fused_assign",
+    "round2_error_profile",
+    "round2_umi_records",
+    "round2_umi_cluster",
+    # --- hot-loop counters (metrics.counter_add) ---
+    "assign.batches",
+    "polish.chunks",
+    "cluster.batched",
+    # --- histogram observations (metrics.observe) ---
+    "polish.chunk_clusters",
+    # --- dispatch sites (device.dispatch / device.timed_get) ---
+    "assign.dispatch",
+    "polish.dispatch",
+    "cluster.batched_dispatch",
+    "consensus.get",
+    "polisher.get",
+    "umi.distance",
+    # --- instant events (trace.instant) ---
+    "chaos.inject",
+    "xla.compile",
+    # --- memory high-water gauges (metrics.gauge_max, device sampler) ---
+    "device.hbm_bytes_in_use",
+    "host.rss_bytes",
+})
+
+KNOWN_SITES = OBS_SITES

@@ -1,0 +1,266 @@
+"""Device instrumentation: dispatch-tax split, recompile audit, memory HWM.
+
+**Dispatch tax** (ROADMAP 1): the round1_polish wall clock is dominated by
+host-side gaps between device dispatches, but ``stage_timing.tsv`` cannot
+say which site pays them. :func:`dispatch` wraps a dispatch call site and
+:func:`timed_get` wraps the matching ``jax.device_get`` / block point;
+together they split every device call into
+
+- ``host_s``  — time inside the dispatch scope NOT spent blocked on the
+  device (input staging, python dispatch, readback bookkeeping), and
+- ``block_s`` — time blocked waiting for device results.
+
+A ``timed_get`` nested inside a ``dispatch`` scope on the same thread
+credits its blocked seconds to the enclosing site (so ``polish.dispatch``
+owns the waits its chunk performs inside ops/consensus); a frameless get
+(e.g. the fused-assign consumer thread, the UMI distance matrix) records
+under its own site. Disarmed, both are one module-attribute check.
+
+**Recompile audit** (ROADMAP 3): a ``jax.monitoring`` duration listener
+counts every XLA backend compile and attributes it to the active stage
+span (:func:`trace.current_label`) plus the innermost dispatch frame's
+shape bucket — ``round1_fused_assign[2048]`` — so "does tenant-to-tenant
+traffic recompile" is a committed number, not a hunch. jax has no
+listener unregistration, so the hook is installed once per process and
+reads the armed registry on every event.
+
+**Memory high-water**: :class:`MemorySampler` (armed at ``telemetry:
+full``) periodically records HBM ``bytes_in_use`` across local devices
+and host RSS as high-water gauges + trace counter events;
+:func:`finalize_memory` additionally one-shots the backend's own
+``peak_bytes_in_use`` and the process ``ru_maxrss`` at roll-up time, so
+the default ``on`` level still reports true peaks without a sampler
+thread.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import resource
+import threading
+import time
+
+from ont_tcrconsensus_tpu.obs import metrics, trace
+
+_tls = threading.local()
+
+#: the jax.monitoring duration event marking one XLA backend compile
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class _Frame:
+    __slots__ = ("site", "bucket", "block_s")
+
+    def __init__(self, site: str, bucket):
+        self.site = site
+        self.bucket = bucket
+        self.block_s = 0.0
+
+
+def _frames() -> list[_Frame]:
+    frames = getattr(_tls, "frames", None)
+    if frames is None:
+        frames = _tls.frames = []
+    return frames
+
+
+@contextlib.contextmanager
+def dispatch(site: str, bucket=None):
+    """Measure one device-dispatch scope at ``site``.
+
+    ``bucket`` labels the static shape family (e.g. the width bucket) for
+    compile attribution. Free no-op when telemetry is off.
+    """
+    reg = metrics._ARMED
+    if reg is None:
+        yield
+        return
+    frames = _frames()
+    frame = _Frame(site, bucket)
+    frames.append(frame)
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        elapsed = time.monotonic() - t0
+        if frames and frames[-1] is frame:
+            frames.pop()
+        reg.dispatch_add(
+            site, dispatches=1,
+            host_s=max(elapsed - frame.block_s, 0.0),
+            block_s=frame.block_s,
+        )
+
+
+def timed_get(site: str, value):
+    """``jax.device_get(value)`` with the blocked seconds attributed to the
+    enclosing :func:`dispatch` frame (or to ``site`` when frameless)."""
+    import jax
+
+    reg = metrics._ARMED
+    if reg is None:
+        return jax.device_get(value)
+    t0 = time.monotonic()
+    out = jax.device_get(value)
+    dt = time.monotonic() - t0
+    frames = getattr(_tls, "frames", None)
+    if frames:
+        frames[-1].block_s += dt
+        reg.dispatch_add(site, gets=1)
+    else:
+        reg.dispatch_add(site, gets=1, block_s=dt)
+    return out
+
+
+# --- recompile audit ---------------------------------------------------------
+
+_LISTENER_INSTALLED = False
+_listener_lock = threading.Lock()
+
+
+def _on_event_duration(event: str, duration: float, **kwargs) -> None:
+    if event != BACKEND_COMPILE_EVENT:
+        return
+    reg = metrics._ARMED
+    if reg is None:
+        return
+    label = trace.current_label() or "<unattributed>"
+    frames = getattr(_tls, "frames", None)
+    if frames and frames[-1].bucket is not None:
+        label = f"{label}[{frames[-1].bucket}]"
+    reg.compile_add(label, duration)
+    trace.instant("xla.compile",
+                  args={"stage": label, "seconds": round(duration, 4)})
+
+
+def install_compile_listener() -> None:
+    """Hook the jax.monitoring compile events (once per process; jax offers
+    no unregistration, so the listener checks the armed registry). A jax
+    build without the monitoring API degrades to no recompile audit —
+    telemetry must never fail the run it measures."""
+    global _LISTENER_INSTALLED
+    with _listener_lock:
+        if _LISTENER_INSTALLED:
+            return
+        try:
+            from jax import monitoring
+
+            monitoring.register_event_duration_secs_listener(_on_event_duration)
+        except Exception as exc:
+            import sys
+
+            sys.stderr.write(
+                f"telemetry: recompile audit unavailable ({exc!r}); "
+                "compile counts will read 0\n"
+            )
+        _LISTENER_INSTALLED = True
+
+
+# --- memory high-water -------------------------------------------------------
+
+
+def _rss_bytes() -> int:
+    """Current resident set size (0 when /proc is unavailable)."""
+    try:
+        with open("/proc/self/statm") as fh:
+            return int(fh.read().split()[1]) * resource.getpagesize()
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def _peak_rss_bytes() -> int:
+    """Process-lifetime peak RSS (ru_maxrss is KiB on Linux)."""
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+
+def _device_bytes_in_use(devices, key: str) -> int | None:
+    """Sum ``key`` over devices' memory_stats; None when no backend reports
+    it (the CPU backend returns no stats — HBM gauges stay absent there)."""
+    total, seen = 0, False
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats and key in stats:
+            total += int(stats[key])
+            seen = True
+    return total if seen else None
+
+
+class MemorySampler:
+    """Background HBM/RSS sampler (armed at ``telemetry: full``)."""
+
+    def __init__(self, interval_s: float = 0.2):
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="obs-memory-sampler", daemon=True
+        )
+
+    def start(self) -> "MemorySampler":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        # bounded join: a device call wedged inside memory_stats() (the
+        # wedged-tunnel scenario) must not hang the run's shutdown path —
+        # the thread is a daemon, so an unjoined straggler dies with the
+        # process instead of wedging it
+        self._thread.join(timeout=2.0)
+        if self._thread.is_alive():
+            import sys
+
+            sys.stderr.write(
+                "telemetry: memory sampler did not stop within 2s "
+                "(device stats call wedged?); leaving the daemon thread\n"
+            )
+
+    def _run(self) -> None:
+        try:
+            import jax
+
+            devices = jax.local_devices()
+        except Exception:
+            devices = []
+        while not self._stop.wait(self.interval_s):
+            reg = metrics._ARMED
+            if reg is None:
+                continue
+            hbm = _device_bytes_in_use(devices, "bytes_in_use")
+            rss = _rss_bytes()
+            if hbm is not None:
+                reg.gauge_max("device.hbm_bytes_in_use", hbm)
+            if rss:
+                reg.gauge_max("host.rss_bytes", rss)
+            col = trace._ARMED
+            if col is not None:
+                values = {"host_rss_bytes": rss}
+                if hbm is not None:
+                    values["hbm_bytes_in_use"] = hbm
+                col.add_counter("memory", values)
+
+
+def start_sampler(interval_s: float = 0.2) -> MemorySampler:
+    return MemorySampler(interval_s).start()
+
+
+def finalize_memory() -> None:
+    """One-shot peak capture at roll-up time (any armed level): the
+    backend's own peak counter beats sampling — it cannot miss a spike
+    between ticks — and ``ru_maxrss`` is the kernel's true host peak."""
+    reg = metrics._ARMED
+    if reg is None:
+        return
+    try:
+        import jax
+
+        peak = _device_bytes_in_use(jax.local_devices(), "peak_bytes_in_use")
+        if peak is None:
+            peak = _device_bytes_in_use(jax.local_devices(), "bytes_in_use")
+        if peak is not None:
+            reg.gauge_max("device.hbm_bytes_in_use", peak)
+    except Exception:  # telemetry must never fail the run it measures
+        pass
+    reg.gauge_max("host.rss_bytes", _peak_rss_bytes())

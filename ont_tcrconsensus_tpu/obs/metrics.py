@@ -1,0 +1,179 @@
+"""Process-wide metrics registry (counters / high-water gauges / histograms).
+
+One registry instance is armed per run (pipeline/run.py) when the
+``telemetry`` config knob is ``on`` or ``full``; every planted call site
+goes through the module-level functions below, which are a single
+module-attribute check when disarmed — the same hot-loop discipline as
+``faults.inject`` and ``watchdog.heartbeat``. Armed, each update is one
+dict operation under a lock (the planted sites are per-batch / per-chunk,
+never per-read).
+
+Beyond the generic counter/gauge/histogram families the registry holds
+the two structured aggregates the telemetry artifact is for:
+
+- **dispatch sites** (fed by :mod:`.device`): per-site dispatch / get
+  counts plus the host-gap vs blocked-on-device seconds split — the
+  ROADMAP-1 dispatch-tax attribution.
+- **compiles** (fed by the :mod:`.device` ``jax.monitoring`` listener):
+  total XLA backend-compile count/seconds plus a per-stage[shape-bucket]
+  breakdown — the ROADMAP-3 recompile audit.
+
+Stage span seconds (fed by :mod:`.trace` at span exit — the same clock
+read that feeds ``stage_timing.tsv``) accumulate here too, so the
+run-level ``telemetry.json`` stage table cannot disagree with the
+per-library TSVs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class MetricsRegistry:
+    """Thread-safe per-run metric store; see :func:`arm`."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.t0_wall = time.time()
+        self.t0_mono = time.monotonic()
+        self.counters: dict[str, float] = {}
+        self.gauges: dict[str, float] = {}  # high-water (max) semantics
+        # site -> [count, sum, min, max]
+        self.hists: dict[str, list[float]] = {}
+        # name -> [seconds, calls]
+        self.stages: dict[str, list[float]] = {}
+        # site -> [n_dispatch, n_get, host_s, block_s]
+        self.dispatch: dict[str, list[float]] = {}
+        # label -> [count, seconds]
+        self.compiles: dict[str, list[float]] = {}
+
+    # --- update API (called via the module-level wrappers) -----------------
+
+    def counter_add(self, site: str, n: float = 1) -> None:
+        with self._lock:
+            self.counters[site] = self.counters.get(site, 0) + n
+
+    def gauge_max(self, site: str, value: float) -> None:
+        with self._lock:
+            if value > self.gauges.get(site, float("-inf")):
+                self.gauges[site] = value
+
+    def observe(self, site: str, value: float) -> None:
+        with self._lock:
+            h = self.hists.get(site)
+            if h is None:
+                self.hists[site] = [1, value, value, value]
+            else:
+                h[0] += 1
+                h[1] += value
+                h[2] = min(h[2], value)
+                h[3] = max(h[3], value)
+
+    def stage_add(self, name: str, seconds: float) -> None:
+        with self._lock:
+            s = self.stages.get(name)
+            if s is None:
+                self.stages[name] = [seconds, 1]
+            else:
+                s[0] += seconds
+                s[1] += 1
+
+    def dispatch_add(self, site: str, *, dispatches: int = 0, gets: int = 0,
+                     host_s: float = 0.0, block_s: float = 0.0) -> None:
+        with self._lock:
+            d = self.dispatch.setdefault(site, [0, 0, 0.0, 0.0])
+            d[0] += dispatches
+            d[1] += gets
+            d[2] += host_s
+            d[3] += block_s
+
+    def compile_add(self, label: str, seconds: float) -> None:
+        with self._lock:
+            c = self.compiles.setdefault(label, [0, 0.0])
+            c[0] += 1
+            c[1] += seconds
+
+    # --- roll-up -----------------------------------------------------------
+
+    def summary(self) -> dict:
+        """The ``telemetry.json`` body (times rounded for stable artifacts)."""
+        with self._lock:
+            compile_n = sum(int(c[0]) for c in self.compiles.values())
+            compile_s = sum(c[1] for c in self.compiles.values())
+            return {
+                "duration_s": round(time.monotonic() - self.t0_mono, 3),
+                "t_wall_start": round(self.t0_wall, 3),
+                "t_mono_start": round(self.t0_mono, 3),
+                "stages": {
+                    k: {"seconds": round(v[0], 3), "calls": int(v[1])}
+                    for k, v in sorted(self.stages.items(),
+                                       key=lambda kv: -kv[1][0])
+                },
+                "dispatch": {
+                    k: {"dispatches": int(v[0]), "gets": int(v[1]),
+                        "host_s": round(v[2], 3), "block_s": round(v[3], 3)}
+                    for k, v in sorted(self.dispatch.items())
+                },
+                "compile": {
+                    "count": compile_n,
+                    "seconds": round(compile_s, 3),
+                    "by_stage": {
+                        k: {"count": int(v[0]), "seconds": round(v[1], 3)}
+                        for k, v in sorted(self.compiles.items(),
+                                           key=lambda kv: -kv[1][1])
+                    },
+                },
+                "counters": {k: self.counters[k] for k in sorted(self.counters)},
+                "gauges": {k: self.gauges[k] for k in sorted(self.gauges)},
+                "histograms": {
+                    k: {"count": int(v[0]), "sum": round(v[1], 3),
+                        "min": v[2], "max": v[3]}
+                    for k, v in sorted(self.hists.items())
+                },
+            }
+
+
+# --- process-wide armed registry (same discipline as faults/watchdog) -------
+
+_ARMED: MetricsRegistry | None = None
+
+
+def arm() -> MetricsRegistry:
+    global _ARMED
+    _ARMED = MetricsRegistry()
+    return _ARMED
+
+
+def disarm() -> None:
+    global _ARMED
+    _ARMED = None
+
+
+def armed() -> bool:
+    return _ARMED is not None
+
+
+def registry() -> MetricsRegistry | None:
+    return _ARMED
+
+
+def counter_add(site: str, n: float = 1) -> None:
+    """Count ``n`` at ``site``; free no-op when telemetry is off."""
+    reg = _ARMED
+    if reg is not None:
+        reg.counter_add(site, n)
+
+
+def gauge_max(site: str, value: float) -> None:
+    """Record a high-water observation; free no-op when telemetry is off."""
+    reg = _ARMED
+    if reg is not None:
+        reg.gauge_max(site, value)
+
+
+def observe(site: str, value: float) -> None:
+    """Record a histogram observation; free no-op when telemetry is off."""
+    reg = _ARMED
+    if reg is not None:
+        reg.observe(site, value)

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ont_tcrconsensus_tpu.obs import device as obs_device
 from ont_tcrconsensus_tpu.ops import pileup
 from ont_tcrconsensus_tpu.parallel.mesh import mesh_data_size
 from ont_tcrconsensus_tpu.ops.encode import PAD_CODE
@@ -613,8 +614,10 @@ def consensus_clusters_batch(
                 d_sub, d_lens, jnp.asarray(drafts_a), jnp.asarray(dlens_a)
             )
             pos_at = maybe_pos[0] if maybe_pos else None
-            new_drafts, new_lens, over1, over2, stable = jax.device_get(
-                (new_drafts, new_lens, over1, over2, stable_d)
+            # blocked-on-device seconds credit the enclosing dispatch
+            # frame (polish.dispatch) — the ROADMAP-1 tax split
+            new_drafts, new_lens, over1, over2, stable = obs_device.timed_get(
+                "consensus.get", (new_drafts, new_lens, over1, over2, stable_d)
             )
             if over1.any() or over2.any():
                 raise ValueError("consensus grew past the padded width")
@@ -639,8 +642,8 @@ def consensus_clusters_batch(
                 )
             # one coalesced device->host transfer (per-array readback pays a
             # flat round-trip each; decisive over a tunneled TPU)
-            new_drafts, new_lens, spans = jax.device_get(
-                (new_drafts, new_lens, spans)
+            new_drafts, new_lens, spans = obs_device.timed_get(
+                "consensus.get", (new_drafts, new_lens, spans)
             )
             new_drafts = new_drafts[:, :W].copy()
             new_lens = new_lens.astype(np.int32).copy()

@@ -34,6 +34,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ont_tcrconsensus_tpu.io import bucketing, fastx
+from ont_tcrconsensus_tpu.obs import device as obs_device
+from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
 from ont_tcrconsensus_tpu.ops import ee_filter, encode, fuzzy_match, sketch, sw_pallas
 from ont_tcrconsensus_tpu.robustness import faults as robustness_faults
 from ont_tcrconsensus_tpu.robustness import watchdog
@@ -1114,7 +1116,10 @@ def run_assign(
                 return
             batch, out_dev = item
             try:
-                consume(batch, jax.device_get(out_dev))
+                # the blocked-on-device wait lands under assign.dispatch
+                # (this thread holds no dispatch frame, so the get records
+                # under its own site) — the device half of the dispatch tax
+                consume(batch, obs_device.timed_get("assign.dispatch", out_dev))
             except BaseException as exc:
                 consumer_err.append(exc)
                 return
@@ -1152,19 +1157,25 @@ def run_assign(
             # dispatch (raises out of run_assign; run.py retries the whole
             # idempotent pass under the transient policy)
             robustness_faults.inject("assign.dispatch")
-            if dispatch is not None:
-                # gate params flow from THIS call site for both paths, so
-                # the EE/length filter cannot drift between them
-                out_dev = dispatch(batch, max_ee_rate, min_len)
-            else:
-                # overlap_frac arms the SW fast path ONLY when no blast-id
-                # gate runs (round 1): round 2's gate needs true blast-ids
-                # for every read, so it keeps the exact full-batch SW
-                out_dev = engine.run_batch_async(
-                    batch, max_ee_rate, min_len,
-                    overlap_frac=(minimal_region_overlap
-                                  if blast_id_threshold is None else None),
-                )
+            obs_metrics.counter_add("assign.batches")
+            # host-gap half of the dispatch tax: time spent STAGING and
+            # dispatching (the async call returns before the device runs);
+            # the consumer thread's device_get above owns the blocked half
+            with obs_device.dispatch("assign.dispatch", bucket=batch.width):
+                if dispatch is not None:
+                    # gate params flow from THIS call site for both paths,
+                    # so the EE/length filter cannot drift between them
+                    out_dev = dispatch(batch, max_ee_rate, min_len)
+                else:
+                    # overlap_frac arms the SW fast path ONLY when no
+                    # blast-id gate runs (round 1): round 2's gate needs
+                    # true blast-ids for every read, so it keeps the exact
+                    # full-batch SW
+                    out_dev = engine.run_batch_async(
+                        batch, max_ee_rate, min_len,
+                        overlap_frac=(minimal_region_overlap
+                                      if blast_id_threshold is None else None),
+                    )
             inflight.put((batch, out_dev))
     finally:
         prefetch_gen.close()  # runs _prefetch's finally: stop + join worker

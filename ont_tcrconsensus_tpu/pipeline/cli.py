@@ -43,12 +43,26 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         description="Count unique TCR molecule nanopore consensus reads (TPU-native)."
     )
-    parser.add_argument("json_config_file", help="Path to analysis run JSON config file")
+    parser.add_argument(
+        "json_config_file",
+        help="Path to analysis run JSON config file (with --report: a "
+        "completed run's workdir — the fastq_pass dir, its nano_tcr "
+        "subdir, or the run config JSON)",
+    )
     parser.add_argument(
         "--cpu", action="store_true",
         help="Force the CPU backend. The TPU plugin registers itself over "
         "JAX_PLATFORMS, so when the device tunnel is wedged any jax init "
         "hangs; the config API is the only reliable override.",
+    )
+    parser.add_argument(
+        "--report", action="store_true",
+        help="Render a human-readable run summary from a completed run's "
+        "committed telemetry/robustness artifacts (telemetry.json, "
+        "robustness_report.json, stage_timing.tsv, logs/trace.json) — "
+        "reads files only, never imports jax, safe on hosts with a "
+        "wedged device tunnel. --validate checks a run's INPUTS before "
+        "it starts; --report explains a run AFTER it ran.",
     )
     parser.add_argument(
         "--validate", action="store_true",
@@ -60,6 +74,12 @@ def main(argv: list[str] | None = None) -> int:
         "problem.",
     )
     args = parser.parse_args(argv)
+
+    if args.report:
+        # never touches jax: safe on hosts with a wedged device tunnel
+        from ont_tcrconsensus_tpu.obs import report as report_mod
+
+        return report_mod.report_main(args.json_config_file)
 
     if args.validate:
         # never touches jax: safe on hosts with a wedged device tunnel

@@ -151,6 +151,15 @@ class RunConfig:
     #   there (one subdir per process) — open with TensorBoard/Perfetto to
     #   see per-kernel device time, HBM traffic and host gaps; the
     #   device-level complement of logs/stage_timing.tsv
+    telemetry: str = "on"  # unified telemetry layer (obs/): "off" disarms
+    #   everything (planted sites are one module-attr check); "on"
+    #   (default) arms the cheap counters, the per-dispatch-site host-gap/
+    #   block split, the XLA recompile audit and the memory high-water
+    #   one-shot, rolled up into a per-run nano_tcr/telemetry.json; "full"
+    #   additionally records the Chrome-trace timeline (logs/trace.json —
+    #   stage spans per thread + instant events for every robustness
+    #   occurrence) and runs the periodic HBM/RSS sampler. Render with
+    #   `tcr-consensus-tpu --report <workdir>`
     error_profile_sample: int = 512  # reads/library profiled for the cs-tag
     #   error artifact (qc/error_profile.py); 0 disables. 512 resolves any
     #   motif above ~1% of reads in the top-40 dump; raise for deeper audits
@@ -336,6 +345,10 @@ class RunConfig:
             raise ValueError(
                 f"verify_resume={self.verify_resume!r} not in "
                 "('off', 'fast', 'full')"
+            )
+        if self.telemetry not in ("off", "on", "full"):
+            raise ValueError(
+                f"telemetry={self.telemetry!r} not in ('off', 'on', 'full')"
             )
         for pat_name in ("umi_fwd", "umi_rev"):
             pat = getattr(self, pat_name)

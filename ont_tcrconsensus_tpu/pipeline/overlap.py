@@ -27,14 +27,17 @@ records only the CRITICAL-PATH cost (the blocking wait at the commit
 point; ~0 when the overlap worked), and the worker's wall clock is
 recorded under ``<stage>_bg`` so the breakdown stays honest about where
 the compute went (bench.py excludes ``_bg`` entries from the
-critical-path sum).
+critical-path sum). The worker clock is an :mod:`obs.trace` span opened
+ON the worker thread — at ``telemetry: full`` the same measurement that
+lands in the TSV's ``<stage>_bg`` row appears as that worker's own named
+row on the trace timeline.
 """
 
 from __future__ import annotations
 
 import threading
-import time
 
+from ont_tcrconsensus_tpu.obs import trace
 from ont_tcrconsensus_tpu.robustness import faults, watchdog
 
 
@@ -53,23 +56,27 @@ class DeferredStage:
         self.worker_seconds = 0.0
 
     def _run(self, fn, args, kwargs) -> None:
-        t0 = time.perf_counter()
+        # the worker's wall clock is a trace span on THIS thread: its one
+        # exit-time measurement is both the `<name>_bg` TSV seconds (via
+        # worker_seconds below) and the worker's row on the trace timeline
+        sp = trace.span(f"{self.name}_bg", cat="overlap")
         try:
-            # liveness: the worker registers its OWN watchdog scope (the
-            # main thread's guards are per-thread), deadline-scaled by the
-            # caller's workload hint — a stalled worker is cancelled with
-            # a StageTimeout that surfaces at commit and takes the
-            # existing recompute-synchronously path
-            with watchdog.guard(f"overlap.{self.name}", units=self.units):
-                # chaos site: a worker thread dying mid-stage (the injected
-                # exception surfaces at commit, like any real worker failure)
-                faults.inject("overlap.worker")
-                watchdog.heartbeat("overlap.worker")
-                self._result = fn(*args, **kwargs)
+            with sp:
+                # liveness: the worker registers its OWN watchdog scope (the
+                # main thread's guards are per-thread), deadline-scaled by the
+                # caller's workload hint — a stalled worker is cancelled with
+                # a StageTimeout that surfaces at commit and takes the
+                # existing recompute-synchronously path
+                with watchdog.guard(f"overlap.{self.name}", units=self.units):
+                    # chaos site: a worker thread dying mid-stage (the injected
+                    # exception surfaces at commit, like any real worker failure)
+                    faults.inject("overlap.worker")
+                    watchdog.heartbeat("overlap.worker")
+                    self._result = fn(*args, **kwargs)
         except BaseException as exc:  # re-raised on the main thread at commit
             self._exc = exc
         finally:
-            self.worker_seconds = time.perf_counter() - t0
+            self.worker_seconds = sp.dur_s
             self._done.set()
             self._permits.release()
 

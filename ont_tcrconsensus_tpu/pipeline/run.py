@@ -37,6 +37,10 @@ import numpy as np
 from ont_tcrconsensus_tpu.cluster import regions as regions_mod
 from ont_tcrconsensus_tpu.io import bucketing, fastx, layout
 from ont_tcrconsensus_tpu.io import validate as validate_mod
+from ont_tcrconsensus_tpu.obs import device as obs_device
+from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
+from ont_tcrconsensus_tpu.obs import report as obs_report
+from ont_tcrconsensus_tpu.obs import trace as obs_trace
 from ont_tcrconsensus_tpu.pipeline import overlap, stages
 from ont_tcrconsensus_tpu.pipeline.config import RunConfig
 from ont_tcrconsensus_tpu.qc import artifacts, umi_overlap
@@ -216,10 +220,30 @@ def _run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]
         _log(f"Watchdog armed: stage_timeout_s={cfg.stage_timeout_s} "
              f"(soft at {watchdog.SOFT_FRACTION:.0%}, auto-scaled by "
              "workload size)")
+    # Telemetry (obs/) is process-global state like the watchdog: armed
+    # INSIDE the try whose finally disarms it, so a failure anywhere —
+    # including mid-arming (an exotic jax without the monitoring API, a
+    # thread-creation failure in the sampler) — still disarms everything
+    # and stops the watchdog; an embedder's next run never inherits this
+    # run's registry or monitor. The body writes the artifacts
+    # (telemetry.json / logs/trace.json) next to the robustness report
+    # while still armed; at "off" the planted sites stay one
+    # module-attribute check.
+    sampler = None
     sigquit_log = _SigquitRunLog()
     try:
+        if cfg.telemetry != "off":
+            obs_metrics.arm()
+            obs_device.install_compile_listener()
+            if cfg.telemetry == "full":
+                obs_trace.arm()
+                sampler = obs_device.start_sampler()
         return _run_with_config_body(cfg, polisher, sigquit_log)
     finally:
+        if sampler is not None:
+            sampler.stop()
+        obs_trace.disarm()
+        obs_metrics.disarm()
         if wd is not None:
             watchdog.deactivate(wd)
             wd.stop()
@@ -435,6 +459,20 @@ def _run_with_config_body(
             ), policy=policy, contracts=contracts.summary())
         except OSError as exc:  # report trouble must never mask the run's fate
             _log(f"WARNING: could not write robustness report: {exc!r}")
+        if cfg.telemetry != "off":
+            # telemetry roll-up next to the robustness report: one-shot
+            # memory peaks (backend peak_bytes_in_use + ru_maxrss), then
+            # telemetry.json (+ logs/trace.json at "full"). Failure and
+            # preemption paths roll up too — a dying run's telemetry is
+            # exactly the telemetry someone needs.
+            try:
+                obs_device.finalize_memory()
+                obs_report.write_run_telemetry(
+                    nano_dir, cfg.telemetry,
+                    suffix="" if n_proc == 1 else f"_p{proc_id}",
+                )
+            except OSError as exc:
+                _log(f"WARNING: could not write telemetry artifacts: {exc!r}")
     if failed_libraries:
         with open(os.path.join(nano_dir, f"failed_libraries_p{proc_id}.log"), "w") as fh:
             for library, err in failed_libraries:

@@ -20,6 +20,8 @@ import numpy as np
 
 from ont_tcrconsensus_tpu.cluster import umi as umi_mod
 from ont_tcrconsensus_tpu.io import bucketing, fastx
+from ont_tcrconsensus_tpu.obs import device as obs_device
+from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
 from ont_tcrconsensus_tpu.ops import consensus as consensus_mod
 from ont_tcrconsensus_tpu.ops import encode
 from ont_tcrconsensus_tpu.robustness import contracts, faults, retry, watchdog
@@ -237,7 +239,12 @@ def cluster_and_select_grouped(
     ]
     groups = [[r.combined for r in recs] for _, recs in eligibles]
     watchdog.heartbeat("cluster.batched_dispatch")
-    clusters_list = umi_mod.cluster_umis_grouped(groups, identity, mesh=mesh)
+    obs_metrics.counter_add("cluster.batched")
+    # one dispatch scope around the whole batched clustering pass: its
+    # device waits (the distance-matrix gets inside cluster/umi.py) are
+    # credited here, the remainder is the pass's host gap
+    with obs_device.dispatch("cluster.batched_dispatch"):
+        clusters_list = umi_mod.cluster_umis_grouped(groups, identity, mesh=mesh)
     out: dict[str, tuple[list[SelectedCluster], list[dict]]] = {}
     # first selection pass (host-only), collecting the rescue work so the
     # second-chance device half runs ONCE across all groups (code-review
@@ -777,11 +784,18 @@ def polish_clusters_all(
                         # progressing, never from many fast chunks
                         watchdog.heartbeat("polish.chunk")
                         faults.inject("polish.dispatch")
-                        seqs = _dispatch_polish_chunk(
-                            chunk, cb_run, s_bucket, width, rounds=rounds,
-                            eff_band=eff_band, keep_pos=keep_pos,
-                            polisher=polisher, mesh=mesh,
-                        )
+                        # dispatch-tax attribution for the dominant stage:
+                        # the device_gets inside ops/consensus and the
+                        # polisher credit their blocked seconds to this
+                        # frame; what remains is round1_polish's host gap
+                        with obs_device.dispatch(
+                            "polish.dispatch", bucket=f"{s_bucket}x{width}",
+                        ):
+                            seqs = _dispatch_polish_chunk(
+                                chunk, cb_run, s_bucket, width, rounds=rounds,
+                                eff_band=eff_band, keep_pos=keep_pos,
+                                polisher=polisher, mesh=mesh,
+                            )
                     except Exception as exc:
                         pol, rec = retry.policy(), retry.recorder()
                         cls = retry.classify(exc)
@@ -837,6 +851,14 @@ def polish_clusters_all(
                         break
                 if requeued:
                     break
+                # chunk counted at RESOLUTION (success or final failure),
+                # after the retry loop and the requeue branch: transient
+                # retries count once, and an OOM-requeued chunk's clusters
+                # count only in the smaller chunks that finally settle
+                # them — so polish.chunk_clusters always sums to the
+                # eligible cluster total, even on degraded runs
+                obs_metrics.counter_add("polish.chunks")
+                obs_metrics.observe("polish.chunk_clusters", len(chunk))
                 if seqs is None:
                     continue
                 for c, seq in enumerate(seqs):

@@ -3,13 +3,20 @@
 The reference has no tracing at all (SURVEY §5: only stderr narration); this
 gives every pipeline run a ``stage_timing.tsv`` artifact so perf work has a
 breakdown to aim at, and ``bench.py`` can print where time goes.
+
+Every ``stage()`` scope measures THROUGH an :mod:`obs.trace` span: the one
+duration computed at span exit feeds this table, the run-level
+``telemetry.json`` stage roll-up, and (at ``telemetry: full``) the
+``trace.json`` timeline row — one clock read, three views that cannot
+disagree.
 """
 
 from __future__ import annotations
 
-import time
 from collections import defaultdict
 from contextlib import contextmanager
+
+from ont_tcrconsensus_tpu.obs import trace
 
 
 class StageTimer:
@@ -21,11 +28,14 @@ class StageTimer:
 
     @contextmanager
     def stage(self, name: str):
-        t0 = time.perf_counter()
+        sp = trace.span(name)
         try:
-            yield
+            with sp:
+                yield
         finally:
-            self.seconds[name] += time.perf_counter() - t0
+            # sp.dur_s was computed in the span's own exit (which already
+            # ran, exception or not) — record the identical measurement
+            self.seconds[name] += sp.dur_s
             self.calls[name] += 1
 
     def add(self, name: str, seconds: float) -> None:

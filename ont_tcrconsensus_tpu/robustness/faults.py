@@ -56,6 +56,8 @@ import sys
 import threading
 import time
 
+from ont_tcrconsensus_tpu.obs import trace as obs_trace
+
 ENV_VAR = "TCR_CHAOS"
 
 #: ``corrupt-input`` / ``truncate-file`` are FILE-level data faults: they
@@ -225,7 +227,15 @@ def fired(site: str) -> int:
         return _PLAN._fired.get(site, 0)
 
 
+def _note_fire(site: str, kind: str) -> None:
+    """Chaos firings become trace instants (no-op below telemetry=full),
+    so an injected fault sits on the same timeline as the stage spans and
+    the retry/stall events it provokes."""
+    obs_trace.instant("chaos.inject", args={"site": site, "kind": kind})
+
+
 def _fire(spec: FaultSpec, site: str) -> None:
+    _note_fire(site, spec.kind)
     msg = spec.message or f"injected {spec.kind} fault at {site}"
     if spec.kind == "transient":
         raise TransientChaosError(f"UNAVAILABLE: {msg}")
@@ -357,6 +367,7 @@ def mutate_input(site: str, path: str) -> str:
     if spec.kind not in ("corrupt-input", "truncate-file"):
         _fire(spec, site)
         return path
+    _note_fire(site, spec.kind)
     import gzip
 
     rng = random.Random(f"{_PLAN.seed}:{site}:{spec.kind}")
@@ -415,6 +426,7 @@ def corrupt_artifact(site: str, path: str) -> bool:
     if spec.kind != "corrupt-artifact":
         _fire(spec, site)
         return False
+    _note_fire(site, spec.kind)
     if not os.path.exists(path):
         sys.stderr.write(f"CHAOS: corrupt-artifact at {site}: {path} "
                          "does not exist; nothing to corrupt\n")
@@ -450,6 +462,7 @@ def tear_write(site: str, path: str, payload: str) -> bool:
     if spec.kind != "torn":
         _fire(spec, site)
         return False
+    _note_fire(site, spec.kind)
     with open(path, "w") as fh:
         fh.write(payload[: max(1, len(payload) // 2)])
     sys.stderr.write(f"CHAOS: tore write of {path} at {site}\n")

@@ -28,6 +28,7 @@ import random
 import threading
 import time
 
+from ont_tcrconsensus_tpu.obs import trace
 from ont_tcrconsensus_tpu.robustness import faults, watchdog
 
 #: substrings marking an exception as HBM/host memory exhaustion. Checked
@@ -127,6 +128,12 @@ class RobustnessRecorder:
             "attempt": attempt,
             "classification": classification,
             "outcome": outcome,
+            # every event carries BOTH clocks: t_wall for humans/cross-run
+            # correlation, t_mono to place the event exactly on the
+            # monotonic trace.json timeline (obs/trace.py maps monotonic
+            # seconds onto trace microseconds)
+            "t_wall": round(time.time(), 6),
+            "t_mono": round(time.monotonic(), 6),
         }
         if error:
             ev["error"] = error
@@ -134,6 +141,13 @@ class RobustnessRecorder:
             ev["detail"] = detail
         with self._lock:
             self.events.append(ev)
+        # mirrored onto the trace timeline as an instant event (free no-op
+        # below `telemetry: full`): retries, stalls, contract violations
+        # and quarantine hits land on the same ruler as the stage spans
+        trace.instant(site, args={
+            "classification": classification, "outcome": outcome,
+            "attempt": attempt,
+        })
 
     def summary(self) -> dict:
         """{site: {attempts, by_classification, by_outcome}} aggregates."""

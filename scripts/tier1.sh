@@ -13,10 +13,12 @@
 # scenarios and the fuzz smokes). Stage 2 re-runs ONLY the fast chaos
 # subset (-m 'chaos and not slow') so a robustness regression is named
 # explicitly in CI output instead of drowning in the full run; pass
-# --no-chaos to skip it. Stage 3 re-runs the differential ingest fuzzer
-# standalone (5 seeds). Stage 4 replays a seeded corpus through the
-# ASan/UBSan parser build (scripts/fuzz_ingest.py --sanitized; the
-# >=1000-corpus campaigns are the slow-marked tests).
+# --no-chaos to skip it. Then: a telemetry smoke (tiny run at
+# telemetry=full — artifacts exist + validate, pipeline outputs
+# byte-identical to telemetry=off), the differential ingest fuzzer
+# standalone (5 seeds), and a seeded-corpus replay through the ASan/UBSan
+# parser build (scripts/fuzz_ingest.py --sanitized; the >=1000-corpus
+# campaigns are the slow-marked tests).
 
 set -o pipefail
 cd "$(dirname "$0")/.."
@@ -75,6 +77,18 @@ if [ "${1:-}" != "--no-chaos" ]; then
     fi
     # the full liveness/integrity matrix (C-level hang, v1-manifest
     # migration e2e) is slow-marked: pytest -m 'chaos' tests/test_chaos.py
+fi
+
+echo "--- telemetry smoke (tiny run at telemetry=full: telemetry.json +"
+echo "    trace.json exist and validate; counts/consensus byte-identical"
+echo "    to a telemetry=off run) ---"
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q \
+    -k "telemetry_full_e2e_artifacts or telemetry_off_is_byte_identical" \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+trc=$?
+if [ "$trc" -ne 0 ]; then
+    echo "telemetry smoke FAILED (rc=$trc)" >&2
+    exit "$trc"
 fi
 
 echo "--- ingest fuzz smoke (native vs Python differential, 5 seeds) ---"

@@ -224,6 +224,72 @@ def test_chaos_parity_is_clean(tmp_path):
     assert findings == []
 
 
+# ---------------------------------------------------------------------------
+# rule family 3b: obs-site cross-check (telemetry mirror of the chaos rule)
+
+_MINI_OBS = (
+    "OBS_SITES = frozenset({'assign.batches', 'polish.dispatch'})\n"
+    "KNOWN_SITES = OBS_SITES\n"
+)
+
+
+def test_misspelled_obs_site_fires_both_directions(tmp_path):
+    findings = lint(tmp_path, {
+        "obs.py": _MINI_OBS,
+        "plant.py": (
+            "import metrics, device\n"
+            "def go():\n"
+            "    metrics.counter_add('asign.batches')\n"  # misspelled
+            "    with device.dispatch('polish.dispatch'):\n"
+            "        pass\n"
+        ),
+    })
+    assert rules_of(findings) == {"obs-unknown-site", "obs-unplanted-site"}
+    unknown = [f for f in findings if f.rule == "obs-unknown-site"]
+    assert len(unknown) == 1 and "asign.batches" in unknown[0].message
+    unplanted = [f for f in findings if f.rule == "obs-unplanted-site"]
+    assert len(unplanted) == 1 and "'assign.batches'" in unplanted[0].message
+    assert unplanted[0].path.endswith("obs.py")  # anchored at the registry
+
+
+def test_obs_parity_is_clean_and_dynamic_names_skip(tmp_path):
+    findings = lint(tmp_path, {
+        "obs.py": _MINI_OBS,
+        "plant.py": (
+            "import metrics, trace, timer\n"
+            "def go(name):\n"
+            "    metrics.counter_add('assign.batches')\n"
+            "    with timer.stage('polish.dispatch'):\n"
+            "        pass\n"
+            "    with trace.span(f'{name}_bg'):\n"  # dynamic: out of scope
+            "        pass\n"
+        ),
+    })
+    assert findings == []
+
+
+def test_obs_registry_does_not_pollute_chaos_known_sites(tmp_path):
+    """The obs registry aliases KNOWN_SITES from a separate OBS_SITES
+    literal on purpose: the chaos rule collects string constants from
+    every ``KNOWN_SITES = ...`` assignment, and an alias assignment
+    carries none — the two vocabularies must not merge (obs entries would
+    all report chaos-unplanted-site)."""
+    findings = lint(tmp_path, {
+        "faults.py": _MINI_FAULTS,
+        "obs.py": _MINI_OBS,
+        "plant.py": (
+            "import faults, metrics, device\n"
+            "def go():\n"
+            "    faults.inject('assign.dispatch')\n"
+            "    faults.inject('polish.dispatch')\n"
+            "    metrics.counter_add('assign.batches')\n"
+            "    with device.dispatch('polish.dispatch'):\n"
+            "        pass\n"
+        ),
+    })
+    assert findings == []
+
+
 _MINI_FAULTS_WITH_KINDS = (
     "KNOWN_SITES = frozenset({'assign.dispatch', 'polish.dispatch'})\n"
     "KINDS = ('transient', 'stall')\n"

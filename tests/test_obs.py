@@ -1,0 +1,392 @@
+"""Unified telemetry layer (obs/): trace schema, recompile audit, disarmed
+overhead, artifact e2e, and the --report renderer.
+
+The e2e pair (telemetry=full vs =off on the same tiny library) is also the
+tier-1 telemetry smoke (scripts/tier1.sh): artifacts must exist and
+validate, and the PIPELINE outputs must be byte-identical — telemetry
+observes the run, it must never change it.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ont_tcrconsensus_tpu.obs import KNOWN_SITES, OBS_SITES
+from ont_tcrconsensus_tpu.obs import device as obs_device
+from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
+from ont_tcrconsensus_tpu.obs import report as obs_report
+from ont_tcrconsensus_tpu.obs import trace as obs_trace
+
+REQUIRED_PHASES = {"X", "i", "M", "C"}
+
+
+def validate_trace(payload: dict) -> None:
+    """Chrome trace-event schema + per-thread monotonic consistency."""
+    assert isinstance(payload.get("traceEvents"), list)
+    spans_by_tid: dict[int, list[tuple[float, float]]] = {}
+    for ev in payload["traceEvents"]:
+        assert ev["ph"] in REQUIRED_PHASES, ev
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        assert isinstance(ev["name"], str) and ev["name"]
+        if ev["ph"] == "M":
+            assert ev["name"] == "thread_name" and ev["args"]["name"]
+            continue
+        assert ev["ts"] >= 0.0, ev
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0.0, ev
+            spans_by_tid.setdefault(ev["tid"], []).append(
+                (ev["ts"], ev["dur"])
+            )
+        elif ev["ph"] == "i":
+            assert ev.get("s") == "t"
+    # spans on one thread must be monotonically consistent: sorted by start
+    # they either nest (scope discipline) or are disjoint — a span can
+    # never PARTIALLY overlap a sibling, which is what a broken clock or a
+    # cross-thread mixup would produce
+    for tid, spans in spans_by_tid.items():
+        open_ends: list[float] = []
+        for ts, dur in sorted(spans):
+            end = ts + dur
+            while open_ends and ts >= open_ends[-1] - 0.5:
+                open_ends.pop()
+            if open_ends:
+                assert end <= open_ends[-1] + 0.5, (
+                    f"tid {tid}: span [{ts}, {end}] partially overlaps "
+                    f"enclosing span ending at {open_ends[-1]}"
+                )
+            open_ends.append(end)
+
+
+@pytest.fixture
+def armed_metrics():
+    reg = obs_metrics.arm()
+    yield reg
+    obs_metrics.disarm()
+
+
+@pytest.fixture
+def armed_trace():
+    col = obs_trace.arm()
+    yield col
+    obs_trace.disarm()
+
+
+# ---------------------------------------------------------------------------
+# trace collector + span plumbing
+
+
+def test_trace_json_schema_and_thread_rows(tmp_path, armed_metrics, armed_trace):
+    with obs_trace.span("round1_polish"):
+        with obs_trace.span("round1_umi_cluster"):
+            time.sleep(0.01)
+        obs_trace.instant("chaos.inject", args={"kind": "transient"})
+    t = threading.Thread(
+        target=lambda: obs_trace.span("round2_umi_cluster").__enter__().__exit__(
+            None, None, None
+        ),
+        name="worker-thread",
+    )
+    t.start()
+    t.join()
+    armed_trace.add_counter("memory", {"host_rss_bytes": 123})
+    path = tmp_path / "trace.json"
+    armed_trace.write(str(path))
+    payload = json.loads(path.read_text())
+    validate_trace(payload)
+    names = {e["name"] for e in payload["traceEvents"]}
+    assert {"round1_polish", "round1_umi_cluster", "round2_umi_cluster",
+            "chaos.inject", "memory", "thread_name"} <= names
+    thread_names = {e["args"]["name"] for e in payload["traceEvents"]
+                    if e["ph"] == "M"}
+    assert "worker-thread" in thread_names
+
+
+def test_trace_buffer_cap_drops_and_reports(tmp_path):
+    """A multi-hour full-telemetry run must not grow RSS without bound:
+    past max_events the collector drops (never silently — the count lands
+    in otherData.dropped_events)."""
+    col = obs_trace.TraceCollector(max_events=3)
+    obs_trace._ARMED = col
+    try:
+        for _ in range(6):
+            obs_trace.instant("chaos.inject")
+    finally:
+        obs_trace.disarm()
+    path = tmp_path / "trace.json"
+    col.write(str(path))
+    payload = json.loads(path.read_text())
+    assert len(payload["traceEvents"]) == 3  # thread meta + 2 instants
+    assert payload["otherData"]["dropped_events"] == 4
+    validate_trace(payload)
+
+
+def test_stage_timer_and_trace_are_one_measurement(armed_metrics, armed_trace):
+    """StageTimer seconds, the registry stage roll-up and the trace span
+    duration all come from the SAME clock-read pair — bit-identical."""
+    from ont_tcrconsensus_tpu.qc.timing import StageTimer
+
+    timer = StageTimer()
+    with timer.stage("round1_polish"):
+        time.sleep(0.01)
+    reg_seconds = armed_metrics.stages["round1_polish"][0]
+    (span_ev,) = [e for e in armed_trace.events if e.get("ph") == "X"]
+    assert timer.seconds["round1_polish"] == reg_seconds
+    assert span_ev["dur"] == reg_seconds * 1e6
+    assert timer.calls["round1_polish"] == 1
+
+
+def test_robustness_events_carry_both_clocks():
+    """Satellite: every robustness_report.json event places on the trace
+    timeline — RobustnessRecorder.record (the single funnel for retry,
+    watchdog, contract, quarantine and resume-verify events) stamps wall
+    AND monotonic time on every event."""
+    from ont_tcrconsensus_tpu.robustness.retry import RobustnessRecorder
+
+    rec = RobustnessRecorder()
+    t_wall0, t_mono0 = time.time(), time.monotonic()
+    rec.record("polish.dispatch", classification="transient", outcome="retried")
+    (ev,) = rec.events
+    assert abs(ev["t_wall"] - t_wall0) < 5.0
+    assert t_mono0 <= ev["t_mono"] <= time.monotonic()
+
+
+# ---------------------------------------------------------------------------
+# recompile audit
+
+
+def test_recompile_counter_new_shape_yes_repeat_no(armed_metrics):
+    import jax
+    import jax.numpy as jnp
+
+    obs_device.install_compile_listener()
+    jitted = jax.jit(lambda x: x * 3 + 1)
+    with obs_trace.span("round1_polish"):
+        jitted(jnp.ones((1733,))).block_until_ready()
+    n_fresh = armed_metrics.summary()["compile"]["count"]
+    assert n_fresh >= 1, "a fresh shape must record >=1 XLA compile"
+    assert any(k.startswith("round1_polish")
+               for k in armed_metrics.compiles), armed_metrics.compiles
+    jitted(jnp.ones((1733,))).block_until_ready()
+    assert armed_metrics.summary()["compile"]["count"] == n_fresh, (
+        "a repeated shape must record 0 new compiles"
+    )
+    jitted(jnp.ones((1741,))).block_until_ready()
+    assert armed_metrics.summary()["compile"]["count"] > n_fresh, (
+        "a new shape bucket must record a new compile"
+    )
+
+
+# ---------------------------------------------------------------------------
+# disarmed overhead
+
+
+def test_disarmed_hot_paths_touch_no_registry():
+    """telemetry=off leaves the planted sites as ONE module-attr check: a
+    method-less sentinel in the slot must blow up the moment any call path
+    touches it — and with the slot at None every call is a silent no-op."""
+    assert obs_metrics._ARMED is None and obs_trace._ARMED is None
+    obs_metrics.counter_add("assign.batches")
+    obs_metrics.gauge_max("host.rss_bytes", 1.0)
+    obs_metrics.observe("polish.chunk_clusters", 4)
+    obs_trace.instant("chaos.inject")
+    with obs_device.dispatch("polish.dispatch", bucket="8x1024"):
+        pass
+    out = obs_device.timed_get("umi.distance", np.arange(4))
+    np.testing.assert_array_equal(out, np.arange(4))
+    sentinel = object()  # no registry methods at all
+    obs_metrics._ARMED = sentinel
+    try:
+        with pytest.raises(AttributeError):
+            obs_metrics.counter_add("assign.batches")
+    finally:
+        obs_metrics._ARMED = None
+    obs_trace._ARMED = sentinel
+    try:
+        with pytest.raises(AttributeError):
+            obs_trace.instant("chaos.inject")
+    finally:
+        obs_trace._ARMED = None
+
+
+def test_dispatch_split_attributes_nested_gets(armed_metrics):
+    """A timed_get inside a dispatch frame credits its blocked seconds to
+    the frame's site; the frame's host_s is what remains."""
+    with obs_device.dispatch("polish.dispatch", bucket="8x1024"):
+        obs_device.timed_get("consensus.get", np.arange(8))
+        time.sleep(0.02)
+    d = armed_metrics.dispatch["polish.dispatch"]
+    assert d[0] == 1 and d[2] >= 0.015  # one dispatch, host_s owns the sleep
+    assert armed_metrics.dispatch["consensus.get"][1] == 1  # the get counted
+    assert armed_metrics.dispatch["consensus.get"][3] == 0.0  # seconds -> frame
+    # frameless get records under its own site
+    obs_device.timed_get("umi.distance", np.arange(8))
+    assert armed_metrics.dispatch["umi.distance"][1] == 1
+
+
+def test_known_sites_registry_is_exported():
+    assert KNOWN_SITES is OBS_SITES
+    assert "polish.dispatch" in KNOWN_SITES and "xla.compile" in KNOWN_SITES
+
+
+def test_config_rejects_bad_telemetry_level():
+    from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+
+    with pytest.raises(ValueError, match="telemetry"):
+        RunConfig.from_dict({
+            "reference_file": "r.fa", "fastq_pass_dir": "fq",
+            "telemetry": "loud",
+        })
+
+
+# ---------------------------------------------------------------------------
+# e2e: artifacts at telemetry=full, byte-identity vs telemetry=off
+
+
+@pytest.fixture(scope="module")
+def obs_library(tmp_path_factory):
+    from ont_tcrconsensus_tpu.io import fastx, simulator
+
+    tmp = tmp_path_factory.mktemp("obs_e2e")
+    lib = simulator.simulate_library(
+        seed=23,
+        num_regions=3,
+        molecules_per_region=(2, 3),
+        reads_per_molecule=(5, 7),
+        sub_rate=0.006,
+        ins_rate=0.003,
+        del_rate=0.003,
+        region_len=(700, 850),
+    )
+    fastx.write_fasta(tmp / "reference.fa", lib.reference.items())
+    fq_dir = tmp / "fastq_pass" / "barcode01"
+    fq_dir.mkdir(parents=True)
+    fastx.write_fastq(fq_dir / "barcode01.fastq.gz", lib.reads)
+    return tmp, lib
+
+
+def _run(src, root, telemetry: str):
+    from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+    from ont_tcrconsensus_tpu.pipeline.run import run_with_config
+
+    root.mkdir(parents=True, exist_ok=True)
+    shutil.copy(src / "reference.fa", root / "reference.fa")
+    shutil.copytree(src / "fastq_pass", root / "fastq_pass")
+    cfg = RunConfig.from_dict({
+        "reference_file": str(root / "reference.fa"),
+        "fastq_pass_dir": str(root / "fastq_pass"),
+        "minimal_length": 600,
+        "min_reads_per_cluster": 4,
+        "read_batch_size": 64,
+        "polish_method": "poa",
+        "delete_tmp_files": False,
+        "telemetry": telemetry,
+    })
+    return run_with_config(cfg), root / "fastq_pass" / "nano_tcr"
+
+
+@pytest.fixture(scope="module")
+def telemetry_runs(obs_library, tmp_path_factory):
+    src, lib = obs_library
+    res_full, nano_full = _run(src, tmp_path_factory.mktemp("t_full"), "full")
+    res_off, nano_off = _run(src, tmp_path_factory.mktemp("t_off"), "off")
+    return lib, res_full, nano_full, res_off, nano_off
+
+
+def test_telemetry_full_e2e_artifacts(telemetry_runs):
+    lib, res_full, nano, _, _ = telemetry_runs
+    assert res_full["barcode01"] == lib.true_counts
+    tele = json.loads((nano / "telemetry.json").read_text())
+    assert tele["telemetry"] == "full"
+    assert tele["stages"], "stage roll-up must be populated"
+    disp = tele["dispatch"]
+    assert disp["assign.dispatch"]["dispatches"] >= 1
+    assert disp["assign.dispatch"]["host_s"] >= 0.0
+    assert "polish.dispatch" in disp and "cluster.batched_dispatch" in disp
+    assert "count" in tele["compile"] and "seconds" in tele["compile"]
+    # peak host RSS is always reported; HBM high-water only on backends
+    # whose devices expose memory_stats (absent on CPU — still a key case)
+    assert tele["gauges"]["host.rss_bytes"] > 0
+    assert isinstance(tele["robustness_events"], dict)
+    trace_payload = json.loads((nano / "logs" / "trace.json").read_text())
+    validate_trace(trace_payload)
+    names = {e["name"] for e in trace_payload["traceEvents"]}
+    assert "round1_polish" in names
+    # the overlap worker's _bg span lands on the worker's own named row
+    assert any(n.endswith("_bg") for n in names)
+    # per-library stage_timing.tsv keeps its exact format (byte-compat
+    # columns + rounding; now derived from the same spans as the trace)
+    tsv = (nano / "barcode01" / "logs" / "stage_timing.tsv").read_text()
+    lines = tsv.splitlines()
+    assert lines[0] == "stage\tseconds\tcalls"
+    for line in lines[1:]:
+        stage, sec, calls = line.split("\t")
+        assert sec == f"{float(sec):.3f}" and int(calls) >= 1
+
+
+def test_telemetry_off_is_byte_identical_and_artifact_free(telemetry_runs):
+    lib, res_full, nano_full, res_off, nano_off = telemetry_runs
+    assert res_off == res_full == {"barcode01": lib.true_counts}
+    assert not (nano_off / "telemetry.json").exists()
+    assert not (nano_off / "logs" / "trace.json").exists()
+    for rel in (
+        ("barcode01", "counts", "umi_consensus_counts.csv"),
+        ("barcode01", "fasta", "merged_consensus.fasta"),
+    ):
+        a = nano_full.joinpath(*rel).read_bytes()
+        b = nano_off.joinpath(*rel).read_bytes()
+        assert a == b, f"telemetry must not change {'/'.join(rel)}"
+
+
+def test_report_renders_without_jax(telemetry_runs):
+    """--report works from the committed artifacts alone, in a process
+    where importing jax is poisoned (the wedged-tunnel scenario)."""
+    _, _, nano, _, _ = telemetry_runs
+    code = (
+        "import sys\n"
+        "sys.modules['jax'] = None\n"  # any `import jax` now raises
+        "from ont_tcrconsensus_tpu.pipeline.cli import main\n"
+        f"sys.exit(main(['--report', {str(nano)!r}]))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "dispatch sites" in proc.stdout
+    assert "XLA compiles" in proc.stdout
+    assert "trace:" in proc.stdout
+
+
+def test_report_degrades_on_valid_json_garbage(tmp_path, capsys):
+    """Never-crash contract (cf. the PR 5 manifest readers): a telemetry
+    artifact that parses but has the wrong shape names the problem and
+    exits 1 instead of raising on the wedged-host diagnosis path."""
+    wd = tmp_path / "nano_tcr"
+    wd.mkdir()
+    (wd / "telemetry.json").write_text('{"stages": [], "dispatch": 7}')
+    (wd / "telemetry_p1.json").write_text('["not", "an", "object"]')
+    (wd / "robustness_report.json").write_text('["garbage"]')
+    assert obs_report.report_main(str(wd)) == 1
+    out = capsys.readouterr().out
+    assert "malformed telemetry artifact" in out
+    assert "unreadable robustness_report.json" in out
+
+
+def test_report_resolves_fastq_pass_dir_and_flags_missing(telemetry_runs, tmp_path, capsys):
+    _, _, nano, _, nano_off = telemetry_runs
+    # parent fastq_pass dir resolves to its nano_tcr child
+    assert obs_report.report_main(str(nano.parent)) == 0
+    # a telemetry-off workdir has no telemetry.json -> exit 1, explained
+    assert obs_report.report_main(str(nano_off)) == 1
+    out = capsys.readouterr().out
+    assert "no telemetry*.json" in out
+    # nonsense target -> exit 2
+    assert obs_report.report_main(str(tmp_path / "nope")) == 2

@@ -8,9 +8,11 @@ from tools.graftlint.rules import (
     exception_guard,
     imports,
     jit_hygiene,
+    obs_sites,
 )
 
-_MODULES = (jit_hygiene, exception_guard, chaos_sites, config_fields, imports)
+_MODULES = (jit_hygiene, exception_guard, chaos_sites, obs_sites,
+            config_fields, imports)
 
 CHECKS = tuple(m.check for m in _MODULES)
 

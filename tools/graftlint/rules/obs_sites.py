@@ -1,0 +1,109 @@
+"""obs-site cross-check vs the telemetry registry (``obs.KNOWN_SITES``).
+
+Mirror of the chaos-site rule (:mod:`.chaos_sites`) for the telemetry
+layer: a typo in a planted metric/span/dispatch-site literal is silent
+forever — the counter/trace row simply never appears under the name a
+dashboard or A/B script greps for — and a registry entry nothing plants
+anymore leaves ``telemetry.json`` consumers reading a field that can
+never be populated. Both directions, cross-file:
+
+- ``obs-unknown-site``   — a site literal passed to a telemetry plant
+  function (``counter_add`` / ``gauge_max`` / ``observe`` / ``span`` /
+  ``instant`` / ``dispatch`` / ``timed_get`` / ``StageTimer.stage``) that
+  is not an ``obs.KNOWN_SITES`` entry;
+- ``obs-unplanted-site`` — a registry entry never planted in the scanned
+  tree (reported at the entry's own line).
+
+The registry is read from the scanned files themselves — the
+``OBS_SITES = frozenset({...})`` assignment in ``obs/__init__.py`` (that
+module aliases it to the public ``KNOWN_SITES`` name; the distinct
+assignment name keeps the chaos rule, which collects every
+``KNOWN_SITES = ...`` literal in scope, from merging the two
+vocabularies). With no definition in scope the checks no-op, so partial
+fixture trees lint quietly.
+
+Dynamically-built names (f-strings like the overlap workers'
+``f"{name}_bg"``, the recorder's per-event instants) are out of scope by
+construction: only string literals are checked, exactly like the chaos
+rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.core import FileCtx, Finding, Project
+
+RULES = {
+    "obs-unknown-site": "telemetry site literal (counter_add/gauge_max/"
+                        "observe/span/instant/dispatch/timed_get/stage) "
+                        "not in obs.KNOWN_SITES (dead metric/span name)",
+    "obs-unplanted-site": "obs.KNOWN_SITES entry not planted at any "
+                          "telemetry call site in the scanned tree",
+}
+
+_PLANT_FUNCS = {
+    "counter_add", "gauge_max", "observe",  # obs.metrics
+    "span", "instant",                      # obs.trace
+    "dispatch", "timed_get",                # obs.device
+    "stage",                                # qc.timing.StageTimer.stage
+}
+
+_REGISTRY_NAME = "OBS_SITES"
+
+
+def known_sites(project: Project) -> dict[str, tuple[str, int]]:
+    """{site: (path, line)} from every ``OBS_SITES = ...`` assignment whose
+    value contains string constants (set/frozenset/tuple literals)."""
+    sites: dict[str, tuple[str, int]] = {}
+    for ctx in project.files:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == _REGISTRY_NAME
+                for t in node.targets
+            )):
+                continue
+            for const in ast.walk(node.value):
+                if isinstance(const, ast.Constant) and isinstance(const.value, str):
+                    sites[const.value] = (ctx.path, const.lineno)
+    return sites
+
+
+def _plant_calls(ctx: FileCtx) -> Iterator[tuple[ast.Call, str]]:
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None
+        )
+        if name not in _PLANT_FUNCS:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            yield node, first.value
+
+
+def check(project: Project) -> Iterator[Finding]:
+    known = known_sites(project)
+    if not known:
+        return
+    planted: set[str] = set()
+    for ctx in project.files:
+        for node, site in _plant_calls(ctx):
+            planted.add(site)
+            if site not in known:
+                yield Finding(
+                    ctx.path, node.lineno, node.col_offset, "obs-unknown-site",
+                    f"site {site!r} is not in obs.KNOWN_SITES — this "
+                    "metric/span can never be found under a registered "
+                    "name (typo?)",
+                )
+    for site, (path, line) in sorted(known.items()):
+        if site not in planted:
+            yield Finding(
+                path, line, 0, "obs-unplanted-site",
+                f"obs.KNOWN_SITES entry {site!r} is planted nowhere in the "
+                "scanned tree — telemetry consumers reading it get nothing",
+            )
