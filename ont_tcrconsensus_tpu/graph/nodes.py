@@ -1,0 +1,531 @@
+"""Stage bodies for the production library graph.
+
+Each node function is a faithful transcription of the corresponding
+segment of the imperative ``run._run_library_impl`` / ``run._run_round2``
+path — same calls, same artifacts, same chaos plants, same degradation
+semantics — minus the scheduling concerns (timing, watchdog guards,
+overlap submission, checkpoint barriers), which the graph executor now
+attaches from the node declarations instead.
+
+Module scope here is jax-free (``--validate`` builds and validates the
+graph without an accelerator stack); the heavy pipeline modules are
+imported lazily inside the bodies.  Pipeline functions are called as
+``stages.<fn>`` module attributes, not from-imports, so test monkeypatches
+on :mod:`~..pipeline.stages` intercept both executors identically.
+
+The context object (``graph.pipeline.LibraryContext``) carries the
+per-library invariants the imperative functions passed positionally:
+config, layout, reference panel, engines, thresholds, polisher, batching
+and the shared ``failed_groups`` / ``failed_regions`` degradation lists.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def _log(*parts: object) -> None:
+    print(*parts, file=sys.stderr)
+
+
+# -- round 1 ---------------------------------------------------------------
+
+
+def round1_fused_assign(ctx, inputs: dict) -> dict:
+    """ONE fused device pass per batch (trim -> EE -> align -> UMI
+    locate), transient-retried, with ingest quarantine when configured."""
+    from ont_tcrconsensus_tpu.io import validate as validate_mod
+    from ont_tcrconsensus_tpu.pipeline import stages
+    from ont_tcrconsensus_tpu.qc import artifacts
+    from ont_tcrconsensus_tpu.robustness import faults, retry
+
+    cfg, lay = ctx.cfg, ctx.lay
+    library = lay.library
+    _log("Preprocessing, aligning and UMI-tagging nanopore reads:", library)
+    fastq = faults.mutate_input("ingest.library_fastq", inputs["library_fastq"])
+    guard = None
+    if cfg.on_bad_record != "fail":
+        guard = validate_mod.IngestGuard(
+            cfg.on_bad_record, source=os.fspath(fastq),
+            quarantine_path=lay.quarantine_path,
+        )
+    try:
+        store, astats = retry.call_with_retry(
+            "assign.round1",
+            lambda: stages.run_assign(
+                fastq, ctx.engine,
+                max_ee_rate=cfg.max_ee_rate_base,
+                min_len=cfg.minimal_length,
+                minimal_region_overlap=cfg.minimal_region_overlap,
+                max_softclip_5_end=cfg.max_softclip_5_end,
+                max_softclip_3_end=cfg.max_softclip_3_end,
+                batch_size=ctx.read_batch,
+                max_read_length=cfg.max_read_length,
+                subsample=cfg.dorado_trim_subsample_fastq,
+                guard=guard,
+            ),
+            reset=guard.reset if guard is not None else None,
+        )
+    finally:
+        # finalize even when the library fails: the quarantine gzip must
+        # gain its trailer and the ingest events must reach the report
+        if guard is not None:
+            qsummary = guard.finalize(retry.recorder())
+            if qsummary["n_bad"]:
+                verb = ("quarantined" if guard.policy == "quarantine"
+                        else "dropped")
+                _log(f"ingest: {qsummary['n_bad']} bad record(s) in "
+                     f"{library} {verb} ({qsummary['by_reason']})")
+    with open(os.path.join(lay.logs, "ee_filter.log"), "w") as fh:
+        fh.write(
+            f"reads passing EE/length filter: {astats.n_total - astats.n_ee_fail}\n"
+        )
+        fh.write(f"reads with primer trim: {astats.n_trimmed}\n")
+    from ont_tcrconsensus_tpu.pipeline import run as run_mod
+
+    run_mod._write_align_log(
+        astats, os.path.join(lay.logs, f"{library}_region_cluster_split.log")
+    )
+    artifacts.write_fastq_stats_log(
+        astats, os.path.join(lay.logs, f"{library}_fastq_stats.log")
+    )
+    artifacts.write_flagstat_log(
+        astats, os.path.join(lay.logs, f"{library}_flagstat.log")
+    )
+    return {"read_store": store, "align_stats": astats}
+
+
+def round1_error_profile(ctx, inputs: dict) -> dict:
+    from ont_tcrconsensus_tpu.qc import error_profile
+
+    counters = error_profile.profile_store(
+        inputs["read_store"], ctx.panel,
+        sample_size=ctx.cfg.error_profile_sample,
+    )
+    return {"r1_qc_profile": counters}
+
+
+def commit_round1_error_profile(ctx, outputs: dict) -> None:
+    from ont_tcrconsensus_tpu.qc import error_profile
+
+    error_profile.write_error_profile_log(
+        *outputs["r1_qc_profile"],
+        os.path.join(ctx.lay.logs, f"{ctx.lay.library}_align_error_profile.log"),
+    )
+
+
+def round1_region_split(ctx, inputs: dict) -> dict:
+    from ont_tcrconsensus_tpu.cluster import regions as regions_mod
+    from ont_tcrconsensus_tpu.pipeline import stages
+    from ont_tcrconsensus_tpu.qc import artifacts
+
+    store, astats = inputs["read_store"], inputs["align_stats"]
+    groups = stages.group_by_region_cluster(store, ctx.panel)
+    artifacts.write_region_split_log(
+        astats, groups, store, ctx.panel.names,
+        {n: len(s) for n, s in ctx.panel.seqs.items()},
+        regions_mod.NEGATIVE_CONTROL_SUFFIXES,
+        os.path.join(
+            ctx.lay.logs,
+            f"{ctx.lay.library}_filter_and_split_reads_by_region_cluster.err",
+        ),
+    )
+    return {"region_groups": groups}
+
+
+def write_region_fastas(ctx, inputs: dict) -> dict:
+    from ont_tcrconsensus_tpu.pipeline import stages
+
+    stages.write_region_fastas(
+        inputs["region_groups"], inputs["read_store"],
+        ctx.lay.region_cluster_fasta, "region_cluster",
+    )
+    return {"region_cluster_fastas": ctx.lay.region_cluster_fasta}
+
+
+def round1_umi_records(ctx, inputs: dict) -> dict:
+    from ont_tcrconsensus_tpu.pipeline import stages
+
+    store, groups = inputs["read_store"], inputs["region_groups"]
+    cfg = ctx.cfg
+    records_by_group: list[tuple[str, list]] = []
+    for cluster_key in sorted(groups):
+        group_name = f"region_cluster{cluster_key}"
+        try:
+            umis = stages.build_umi_records(
+                store, groups[cluster_key], cfg.max_pattern_dist
+            )
+            if not umis:
+                continue
+            if cfg.write_intermediate_fastas:
+                stages.write_umi_fasta(
+                    umis, store,
+                    os.path.join(
+                        ctx.lay.umi_fasta, f"{group_name}_detected_umis.fasta"
+                    ),
+                )
+            records_by_group.append((group_name, umis))
+        except Exception as exc:
+            ctx.failed_groups.append((group_name, repr(exc)))
+            _log(f"WARNING: {group_name} failed and is skipped: {exc!r}")
+    return {"records_by_group": records_by_group}
+
+
+def round1_umi_cluster(ctx, inputs: dict) -> dict:
+    """ONE library-wide batched clustering pass; a deterministic batched
+    failure degrades to per-group retries so one bad group cannot poison
+    its peers."""
+    from ont_tcrconsensus_tpu.pipeline import stages
+    from ont_tcrconsensus_tpu.robustness import faults, retry
+
+    cfg = ctx.cfg
+    records_by_group = inputs["records_by_group"]
+
+    def _batched_r1():
+        faults.inject("cluster.batched_round1")
+        return stages.cluster_and_select_grouped(
+            records_by_group,
+            identity=cfg.vsearch_identity,
+            min_umi_length=cfg.min_umi_length,
+            max_umi_length=cfg.max_umi_length,
+            min_reads_per_cluster=cfg.min_reads_per_cluster,
+            max_reads_per_cluster=cfg.max_reads_per_cluster,
+            balance_strands=cfg.balance_strands,
+            mesh=ctx.engine.mesh,
+        )
+
+    grouped = None
+    try:
+        grouped = retry.call_with_retry("cluster.batched_round1", _batched_r1)
+    except Exception as exc:
+        retry.recorder().record(
+            "cluster.batched_round1", classification=retry.classify(exc),
+            outcome="degraded", error=repr(exc),
+        )
+        _log(f"WARNING: batched UMI clustering failed ({exc!r}); "
+             "retrying each region cluster individually")
+    selected_by_group: list[tuple[str, list]] = []
+    for group_name, umis in records_by_group:
+        try:
+            if grouped is not None:
+                selected, stat_rows = grouped[group_name]
+            else:
+                selected, stat_rows = stages.cluster_and_select(
+                    umis,
+                    identity=cfg.vsearch_identity,
+                    min_umi_length=cfg.min_umi_length,
+                    max_umi_length=cfg.max_umi_length,
+                    min_reads_per_cluster=cfg.min_reads_per_cluster,
+                    max_reads_per_cluster=cfg.max_reads_per_cluster,
+                    balance_strands=cfg.balance_strands,
+                    mesh=ctx.engine.mesh,
+                )
+            cdir = os.path.join(ctx.lay.clustering, group_name)
+            os.makedirs(cdir, exist_ok=True)
+            stages.write_cluster_stats_tsv(
+                stat_rows, os.path.join(cdir, "vsearch_cluster_stats.tsv")
+            )
+            if selected:
+                selected_by_group.append((group_name, selected))
+        except Exception as exc:
+            ctx.failed_groups.append((group_name, repr(exc)))
+            _log(f"WARNING: {group_name} failed and is skipped: {exc!r}")
+    return {"selected_by_group": selected_by_group}
+
+
+def round1_polish(ctx, inputs: dict) -> dict:
+    from ont_tcrconsensus_tpu.pipeline import stages
+
+    selected_by_group = inputs["selected_by_group"]
+    n_clusters = sum(len(s) for _, s in selected_by_group)
+    _log(f"Polishing clusters: {ctx.lay.library} "
+         f"({n_clusters} clusters over {len(selected_by_group)} region clusters)")
+    by_group, polish_failed = stages.polish_clusters_all(
+        selected_by_group, inputs["read_store"],
+        max_read_length=ctx.cfg.max_read_length,
+        polisher=ctx.polisher,
+        budget=ctx.budget,
+        cluster_batch=ctx.cfg.cluster_batch_size,
+        mesh=ctx.engine.mesh,
+    )
+    return {"r1_polished": (by_group, polish_failed)}
+
+
+def round1_consensus(ctx, inputs: dict) -> dict:
+    """Merged consensus assembly + the round-1 resume checkpoint: an
+    incomplete round 1 is NOT checkpointed so resume retries the failed
+    groups instead of reusing a consensus missing them."""
+    from ont_tcrconsensus_tpu.io import fastx
+    from ont_tcrconsensus_tpu.robustness import contracts, faults, shutdown
+
+    lay = ctx.lay
+    by_group, polish_failed = inputs["r1_polished"]
+    merged_consensus: list[tuple[str, str]] = []
+    for group_name, selected in inputs["selected_by_group"]:
+        if group_name in polish_failed:
+            ctx.failed_groups.append((group_name, polish_failed[group_name]))
+            _log(f"WARNING: {group_name} polish failed and is skipped: "
+                 f"{polish_failed[group_name]}")
+        else:
+            # conservation: every selected cluster of a non-failed group
+            # must have produced exactly one consensus record
+            contracts.check_equal(
+                "consensus", f"{group_name} consensus records",
+                len(by_group[group_name]), "selected clusters", len(selected),
+                detail={"library": lay.library, "group": group_name},
+            )
+            merged_consensus.extend(by_group[group_name])
+    if ctx.failed_groups:
+        _log(
+            "Not all umi cluster region fastas were successfully polished! "
+            f"Incomplete: {[g for g, _ in ctx.failed_groups]}"
+        )
+        with open(os.path.join(lay.logs, "incomplete_region_clusters.log"), "w") as fh:
+            for group_name, err in ctx.failed_groups:
+                fh.write(f"{group_name}\t{err}\n")
+    merged_path = os.path.join(lay.fasta, "merged_consensus.fasta")
+    n_written = fastx.write_fasta(merged_path, merged_consensus)
+    contracts.check_equal(
+        "consensus", "merged_consensus.fasta records written", n_written,
+        "in-memory consensus entries", len(merged_consensus),
+        detail={"library": lay.library},
+    )
+    if not ctx.failed_groups:
+        lay.mark_stage_done("round1_consensus", artifacts=[merged_path])
+    # chaos site + preemption checkpoint at the round-1 commit: the
+    # canonical mid-stage death — the manifest just committed, so a kill
+    # here resumes into round 2 only, byte-identically
+    faults.inject("run.round1_checkpoint")
+    shutdown.checkpoint("run.round1_checkpoint")
+    return {"merged_consensus": merged_consensus, "merged_fasta": merged_path}
+
+
+def round1_resume_probe(ctx):
+    path = os.path.join(ctx.lay.fasta, "merged_consensus.fasta")
+    return path if os.path.exists(path) else None
+
+
+def round1_resume_reload(ctx) -> dict:
+    from ont_tcrconsensus_tpu.io import fastx
+
+    merged_path = os.path.join(ctx.lay.fasta, "merged_consensus.fasta")
+    _log("Resuming from round-1 consensus:", ctx.lay.library)
+    merged_consensus = [
+        (rec.header, rec.sequence) for rec in fastx.read_fastx(merged_path)
+    ]
+    return {"merged_consensus": merged_consensus}
+
+
+# -- round 2 ---------------------------------------------------------------
+
+
+def round2_fused_assign(ctx, inputs: dict) -> dict:
+    from ont_tcrconsensus_tpu.io import fastx
+    from ont_tcrconsensus_tpu.pipeline import run as run_mod
+    from ont_tcrconsensus_tpu.pipeline import stages
+    from ont_tcrconsensus_tpu.qc import artifacts
+    from ont_tcrconsensus_tpu.robustness import retry
+
+    cfg, lay = ctx.cfg, ctx.lay
+    merged_consensus = inputs["merged_consensus"]
+    _log("Aligning unique molecule consensus TCR sequences:", lay.library)
+    cons_records = [fastx.FastxRecord(h, "", s) for h, s in merged_consensus]
+    qc_rows: list[dict] = []
+    dispatch = None
+    if cfg.round2_targeted_assign:
+        dispatch, why_not = run_mod._targeted_round2_dispatch(
+            ctx.panel, ctx.engine_notrim, (h for h, _ in merged_consensus)
+        )
+        if dispatch is None:
+            _log(f"round 2: targeted assign unavailable ({why_not}); "
+                 "falling back to the full fused assign")
+    cons_store, cstats = retry.call_with_retry(
+        "assign.round2",
+        lambda: stages.run_assign(
+            cons_records, ctx.engine_notrim,
+            max_ee_rate=1.0,  # no quality data on consensus sequences
+            min_len=1,
+            minimal_region_overlap=ctx.overlap_consensus,
+            max_softclip_5_end=cfg.max_softclip_5_end,
+            max_softclip_3_end=cfg.max_softclip_3_end,
+            batch_size=ctx.read_batch,
+            max_read_length=cfg.max_read_length,
+            blast_id_threshold=ctx.blast_id_threshold,
+            collect_qc=qc_rows,
+            dispatch=dispatch,
+        ),
+        reset=qc_rows.clear,
+    )
+    artifacts.write_consensus_filter_artifacts(
+        qc_rows,
+        {n: len(s) for n, s in ctx.panel.seqs.items()},
+        lay.logs,
+        "merged_consensus",
+        blast_id_threshold=ctx.blast_id_threshold,
+        minimal_region_overlap=ctx.overlap_consensus,
+    )
+    artifacts.write_flagstat_log(
+        cstats, os.path.join(lay.logs, "merged_consensus_flagstat.log")
+    )
+    return {"cons_store": cons_store}
+
+
+def round2_error_profile(ctx, inputs: dict) -> dict:
+    from ont_tcrconsensus_tpu.qc import error_profile
+
+    counters = error_profile.profile_store(
+        inputs["cons_store"], ctx.panel,
+        sample_size=ctx.cfg.error_profile_sample,
+    )
+    return {"r2_qc_profile": counters}
+
+
+def commit_round2_error_profile(ctx, outputs: dict) -> None:
+    from ont_tcrconsensus_tpu.qc import error_profile
+
+    error_profile.write_error_profile_log(
+        *outputs["r2_qc_profile"],
+        os.path.join(ctx.lay.logs, "merged_consensus_align_error_profile.log"),
+    )
+
+
+def round2_umi_records(ctx, inputs: dict) -> dict:
+    from ont_tcrconsensus_tpu.pipeline import stages
+
+    cfg = ctx.cfg
+    cons_store = inputs["cons_store"]
+    region_groups = stages.group_by_region(cons_store, ctx.panel)
+    if cfg.write_intermediate_fastas:
+        stages.write_region_fastas(
+            region_groups, cons_store, ctx.lay.region_fasta, "region_"
+        )
+    region_records: list[tuple[str, list]] = []
+    for region, parts in sorted(region_groups.items()):
+        try:
+            umis = stages.build_umi_records(
+                cons_store, parts, cfg.max_pattern_dist
+            )
+            if not umis:
+                continue
+            if cfg.write_intermediate_fastas:
+                stages.write_umi_fasta(
+                    umis, cons_store,
+                    os.path.join(
+                        ctx.lay.consensus_umi_fasta,
+                        f"region_{region}_detected_umis.fasta",
+                    ),
+                )
+            region_records.append((region, umis))
+        except Exception as exc:
+            ctx.failed_regions.append((region, repr(exc)))
+            _log(f"WARNING: round-2 region {region} failed and is skipped: {exc!r}")
+    return {"region_records": region_records}
+
+
+def round2_umi_cluster(ctx, inputs: dict) -> dict:
+    from ont_tcrconsensus_tpu.pipeline import stages
+    from ont_tcrconsensus_tpu.robustness import faults, retry
+
+    cfg = ctx.cfg
+    region_records = inputs["region_records"]
+
+    def _batched_r2():
+        faults.inject("cluster.batched_round2")
+        return stages.cluster_and_select_grouped(
+            region_records,
+            identity=cfg.vsearch_identity_consensus,
+            min_umi_length=cfg.min_umi_length,
+            max_umi_length=cfg.max_umi_length,
+            min_reads_per_cluster=1,
+            max_reads_per_cluster=cfg.max_reads_per_cluster,
+            balance_strands=False,
+            mesh=ctx.engine_notrim.mesh,
+        )
+
+    grouped2 = None
+    try:
+        grouped2 = retry.call_with_retry("cluster.batched_round2", _batched_r2)
+    except Exception as exc:
+        retry.recorder().record(
+            "cluster.batched_round2", classification=retry.classify(exc),
+            outcome="degraded", error=repr(exc),
+        )
+        _log(f"WARNING: batched round-2 UMI clustering failed ({exc!r}); "
+             "retrying each region individually")
+    selected_by_region: list[tuple[str, list, list]] = []
+    for region, umis in region_records:
+        try:
+            if grouped2 is not None:
+                selected, stat_rows = grouped2[region]
+            else:
+                selected, stat_rows = stages.cluster_and_select(
+                    umis,
+                    identity=cfg.vsearch_identity_consensus,
+                    min_umi_length=cfg.min_umi_length,
+                    max_umi_length=cfg.max_umi_length,
+                    min_reads_per_cluster=1,
+                    max_reads_per_cluster=cfg.max_reads_per_cluster,
+                    balance_strands=False,
+                    mesh=ctx.engine_notrim.mesh,
+                )
+            selected_by_region.append((region, selected, stat_rows))
+        except Exception as exc:
+            ctx.failed_regions.append((region, repr(exc)))
+            _log(f"WARNING: round-2 region {region} failed and is skipped: {exc!r}")
+    return {"selected_by_region": selected_by_region}
+
+
+def round2_counts(ctx, inputs: dict) -> dict:
+    """Per-region artifacts + counts CSV + the counts manifest mark;
+    incomplete counts are not checkpointed so resume retries."""
+    import shutil
+
+    from ont_tcrconsensus_tpu.pipeline import run as run_mod
+    from ont_tcrconsensus_tpu.pipeline import stages
+    from ont_tcrconsensus_tpu.qc import umi_overlap
+    from ont_tcrconsensus_tpu.robustness import contracts
+
+    cfg, lay = ctx.cfg, ctx.lay
+    cons_store = inputs["cons_store"]
+    region_counts: dict[str, int] = {}
+    region_cluster_umis: dict[str, list[str]] = {}
+    for region, selected, stat_rows in inputs["selected_by_region"]:
+        try:
+            run_mod._finish_round2_region(
+                region, selected, stat_rows, cons_store, lay, cfg,
+                region_counts, region_cluster_umis,
+            )
+        except Exception as exc:
+            ctx.failed_regions.append((region, repr(exc)))
+            _log(f"WARNING: round-2 region {region} failed and is skipped: {exc!r}")
+    if ctx.failed_regions:
+        with open(os.path.join(lay.logs, "incomplete_regions.log"), "w") as fh:
+            for region, err in ctx.failed_regions:
+                fh.write(f"{region}\t{err}\n")
+
+    counts_csv = stages.write_counts_csv(region_counts, lay.counts)
+    contracts.check_equal(
+        "counts", "counts CSV readback", run_mod._read_counts_csv(counts_csv),
+        "in-memory region counts", region_counts,
+        detail={"library": lay.library},
+    )
+    if cfg.compare_umi_overlap_between_regions:
+        _log("Testing for consensus umi matches between regions:", lay.library)
+        umi_overlap.count_overlapping_umis(
+            region_cluster_umis, lay.logs, cfg.overlapping_umi_edit_threshold
+        )
+    # the stage-timing artifact lands before the counts manifest mark,
+    # like the imperative path: a crash in between leaves counts unmarked
+    # and resume regenerates both
+    ctx.timer.write_tsv(os.path.join(lay.logs, "stage_timing.tsv"))
+    if not ctx.failed_groups and not ctx.failed_regions:
+        lay.mark_stage_done("counts", artifacts=[counts_csv])
+
+    if cfg.delete_tmp_files:
+        for d in (lay.region_cluster_fasta, lay.clustering, lay.umi_fasta,
+                  lay.fasta, lay.clustering_consensus, lay.region_fasta,
+                  lay.consensus_umi_fasta):
+            shutil.rmtree(d, ignore_errors=True)
+
+    return {"region_counts": region_counts, "counts_csv": counts_csv}

@@ -1,0 +1,154 @@
+"""The production library graph: round1→round2 declared as nodes/edges.
+
+:func:`build_library_graph` is the single place the pipeline's dataflow
+shape lives.  Placements encode the port's memory story: the two read
+stores are ``hbm`` (columnar blocks stay device-resident from the fused
+assign through polish / counting — the executor drops them right after
+their last consumer, making donation safe), orchestration values are
+``host``, and the two checkpoint artifacts (merged consensus fasta,
+counts CSV) are ``disk`` — the only placement a resume can reload.
+
+Which nodes run off the critical path is *derived*, not configured: the
+error-profile passes and the intermediate region fastas produce edges
+nothing consumes, so :meth:`GraphSpec.side_sinks` routes them through the
+shared worker pool automatically.  ``overlap_qc`` only decides whether a
+worker pool exists at all.
+
+Conditional stages (error profiling, intermediate fastas) are included
+or excluded at build time from the config, so the built graph never
+contains dangling edges.  Module scope is jax-free: ``--validate``
+builds and validates this graph without an accelerator stack.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from ont_tcrconsensus_tpu.graph import nodes as N
+from ont_tcrconsensus_tpu.graph.ir import GraphBuilder, GraphSpec
+from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+
+
+@dataclasses.dataclass
+class LibraryContext:
+    """Per-library invariants shared by every node (the values the
+    imperative path threaded positionally), plus the degradation lists
+    the graceful-skip paths append to."""
+
+    cfg: Any
+    lay: Any
+    timer: Any
+    panel: Any = None
+    engine: Any = None
+    engine_notrim: Any = None
+    blast_id_threshold: float = 0.0
+    overlap_consensus: int = 0
+    polisher: Any = None
+    read_batch: int = 0
+    budget: Any = None
+    failed_groups: list = dataclasses.field(default_factory=list)
+    failed_regions: list = dataclasses.field(default_factory=list)
+
+
+def build_library_graph(cfg: RunConfig) -> GraphSpec:
+    b = GraphBuilder("library")
+    b.input("library_fastq", "disk")
+    b.edge("read_store", "hbm")
+    b.edge("align_stats", "host")
+    b.edge("region_groups", "host")
+    b.edge("records_by_group", "host")
+    b.edge("selected_by_group", "host")
+    b.edge("r1_polished", "host")
+    b.edge("merged_consensus", "host")
+    b.edge("merged_fasta", "disk")
+    b.edge("cons_store", "hbm")
+    b.edge("region_records", "host")
+    b.edge("selected_by_region", "host")
+    b.edge("region_counts", "host")
+    b.edge("counts_csv", "disk")
+    if cfg.error_profile_sample:
+        b.edge("r1_qc_profile", "host")
+        b.edge("r2_qc_profile", "host")
+    if cfg.write_intermediate_fastas:
+        b.edge("region_cluster_fastas", "disk")
+
+    b.add_node(
+        "round1_fused_assign", N.round1_fused_assign,
+        inputs=("library_fastq",), outputs=("read_store", "align_stats"),
+    )
+    if cfg.error_profile_sample:
+        b.add_node(
+            "round1_error_profile", N.round1_error_profile,
+            inputs=("read_store",), outputs=("r1_qc_profile",),
+            commit=N.commit_round1_error_profile,
+            units=lambda ctx, inputs: ctx.cfg.error_profile_sample,
+        )
+    b.add_node(
+        "round1_region_split", N.round1_region_split,
+        inputs=("read_store", "align_stats"), outputs=("region_groups",),
+    )
+    if cfg.write_intermediate_fastas:
+        b.add_node(
+            "write_region_fastas", N.write_region_fastas,
+            inputs=("read_store", "region_groups"),
+            outputs=("region_cluster_fastas",),
+        )
+    b.add_node(
+        "round1_umi_records", N.round1_umi_records,
+        inputs=("read_store", "region_groups"), outputs=("records_by_group",),
+    )
+    b.add_node(
+        "round1_umi_cluster", N.round1_umi_cluster,
+        inputs=("records_by_group",), outputs=("selected_by_group",),
+        units=lambda ctx, inputs: sum(
+            len(u) for _, u in inputs["records_by_group"]
+        ),
+    )
+    b.add_node(
+        "round1_polish", N.round1_polish,
+        inputs=("read_store", "selected_by_group"), outputs=("r1_polished",),
+        units=lambda ctx, inputs: sum(
+            len(s) for _, s in inputs["selected_by_group"]
+        ),
+    )
+    b.add_node(
+        "round1_consensus", N.round1_consensus,
+        inputs=("selected_by_group", "r1_polished"),
+        outputs=("merged_consensus", "merged_fasta"),
+        resume_key="round1_consensus",
+        resume_probe=N.round1_resume_probe,
+        resume_reload=N.round1_resume_reload,
+        resume_provides=("merged_consensus",),
+    )
+    b.add_node(
+        "round2_fused_assign", N.round2_fused_assign,
+        inputs=("merged_consensus",), outputs=("cons_store",),
+        units=lambda ctx, inputs: len(inputs["merged_consensus"]),
+    )
+    if cfg.error_profile_sample:
+        b.add_node(
+            "round2_error_profile", N.round2_error_profile,
+            inputs=("cons_store",), outputs=("r2_qc_profile",),
+            commit=N.commit_round2_error_profile,
+            units=lambda ctx, inputs: ctx.cfg.error_profile_sample,
+        )
+    b.add_node(
+        "round2_umi_records", N.round2_umi_records,
+        inputs=("cons_store",), outputs=("region_records",),
+    )
+    b.add_node(
+        "round2_umi_cluster", N.round2_umi_cluster,
+        inputs=("region_records",), outputs=("selected_by_region",),
+        units=lambda ctx, inputs: sum(
+            len(u) for _, u in inputs["region_records"]
+        ),
+    )
+    b.add_node(
+        "round2_counts", N.round2_counts,
+        inputs=("cons_store", "selected_by_region"),
+        outputs=("region_counts", "counts_csv"),
+        checkpoint=True,
+    )
+    b.result("region_counts")
+    return b.build()

@@ -544,6 +544,24 @@ def validate_inputs(config_path: str, out=None) -> int:
         p("validate: FAIL (1 problem)")
         return 1
 
+    # executor knob: a graph-executor config must declare a graph that
+    # passes builder validation (cycles, undeclared/dangling edges, hbm
+    # edges crossing a disk-resume boundary, ...) — each named problem is
+    # surfaced here, BEFORE a run wastes device time. graph/ is jax-free,
+    # so this stays safe on a machine without an accelerator stack.
+    if cfg.executor == "graph":
+        from ont_tcrconsensus_tpu.graph import pipeline as graph_pipeline
+        from ont_tcrconsensus_tpu.graph.ir import GraphValidationError
+
+        try:
+            spec = graph_pipeline.build_library_graph(cfg)
+        except GraphValidationError as exc:
+            problems.extend(f"stage graph: {prob}" for prob in exc.problems)
+        else:
+            p(f"validate: stage graph: {len(spec.schedule)} nodes, "
+              f"{len(spec.edges)} edges, "
+              f"{len(spec.side_sinks())} off-critical-path")
+
     from ont_tcrconsensus_tpu.io import fastx
 
     try:

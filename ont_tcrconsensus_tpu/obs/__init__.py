@@ -48,17 +48,22 @@ from __future__ import annotations
 # these into the chaos registry; the public alias below keeps the
 # ``obs.KNOWN_SITES`` API symmetric with ``faults.KNOWN_SITES``.
 OBS_SITES = frozenset({
-    # --- stage spans (qc/timing.StageTimer -> trace.span) ---
+    # --- stage spans (qc/timing.StageTimer -> trace.span; every name is
+    # also a graph node, declared in graph/pipeline.py — graftlint's
+    # graph-sites rule holds GRAPH_NODES ⊆ OBS_SITES) ---
     "round1_fused_assign",
     "round1_error_profile",
+    "round1_region_split",
     "write_region_fastas",
     "round1_umi_records",
     "round1_umi_cluster",
     "round1_polish",
+    "round1_consensus",
     "round2_fused_assign",
     "round2_error_profile",
     "round2_umi_records",
     "round2_umi_cluster",
+    "round2_counts",
     # --- hot-loop counters (metrics.counter_add) ---
     "assign.batches",
     "polish.chunks",

@@ -47,6 +47,10 @@ class MetricsRegistry:
         self.dispatch: dict[str, list[float]] = {}
         # label -> [count, seconds]
         self.compiles: dict[str, list[float]] = {}
+        # graph node -> [critical_s, overlapped_s, runs, skips]
+        self.graph_nodes: dict[str, list[float]] = {}
+        # graph edge -> placement ("hbm" | "host" | "disk")
+        self.graph_edges: dict[str, str] = {}
 
     # --- update API (called via the module-level wrappers) -----------------
 
@@ -94,6 +98,23 @@ class MetricsRegistry:
             c[0] += 1
             c[1] += seconds
 
+    def graph_node_add(self, name: str, *, critical_s: float = 0.0,
+                       overlapped_s: float = 0.0) -> None:
+        with self._lock:
+            g = self.graph_nodes.setdefault(name, [0.0, 0.0, 0, 0])
+            g[0] += critical_s
+            g[1] += overlapped_s
+            g[2] += 1
+
+    def graph_node_skip(self, name: str) -> None:
+        with self._lock:
+            g = self.graph_nodes.setdefault(name, [0.0, 0.0, 0, 0])
+            g[3] += 1
+
+    def graph_edge_set(self, name: str, placement: str) -> None:
+        with self._lock:
+            self.graph_edges[name] = placement
+
     # --- roll-up -----------------------------------------------------------
 
     def summary(self) -> dict:
@@ -101,7 +122,7 @@ class MetricsRegistry:
         with self._lock:
             compile_n = sum(int(c[0]) for c in self.compiles.values())
             compile_s = sum(c[1] for c in self.compiles.values())
-            return {
+            out = {
                 "duration_s": round(time.monotonic() - self.t0_mono, 3),
                 "t_wall_start": round(self.t0_wall, 3),
                 "t_mono_start": round(self.t0_mono, 3),
@@ -132,6 +153,20 @@ class MetricsRegistry:
                     for k, v in sorted(self.hists.items())
                 },
             }
+            # graph-executor section: present only when a graph actually
+            # ran, so imperative-path telemetry keeps its exact shape
+            if self.graph_nodes or self.graph_edges:
+                out["graph"] = {
+                    "nodes": {
+                        k: {"critical_s": round(v[0], 3),
+                            "overlapped_s": round(v[1], 3),
+                            "runs": int(v[2]), "skips": int(v[3])}
+                        for k, v in sorted(self.graph_nodes.items())
+                    },
+                    "edges": {k: self.graph_edges[k]
+                              for k in sorted(self.graph_edges)},
+                }
+            return out
 
 
 # --- process-wide armed registry (same discipline as faults/watchdog) -------
@@ -177,3 +212,27 @@ def observe(site: str, value: float) -> None:
     reg = _ARMED
     if reg is not None:
         reg.observe(site, value)
+
+
+def graph_node_add(name: str, *, critical_s: float = 0.0,
+                   overlapped_s: float = 0.0) -> None:
+    """Record one graph-node execution (critical-path seconds vs seconds
+    spent on a worker thread); free no-op when telemetry is off."""
+    reg = _ARMED
+    if reg is not None:
+        reg.graph_node_add(name, critical_s=critical_s,
+                           overlapped_s=overlapped_s)
+
+
+def graph_node_skip(name: str) -> None:
+    """Record a resume skip of a graph node; free no-op when off."""
+    reg = _ARMED
+    if reg is not None:
+        reg.graph_node_skip(name)
+
+
+def graph_edge_set(name: str, placement: str) -> None:
+    """Record a graph edge's declared placement; free no-op when off."""
+    reg = _ARMED
+    if reg is not None:
+        reg.graph_edge_set(name, placement)

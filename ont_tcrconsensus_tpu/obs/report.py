@@ -96,6 +96,27 @@ def _render_telemetry(data: dict, lines: list[str]) -> None:
         for name, s in stages.items():
             lines.append(f"  {name:28s} {s['seconds']:9.3f}s  "
                          f"x{s['calls']}")
+    graph = data.get("graph") or {}
+    gnodes = graph.get("nodes", {})
+    if gnodes:
+        lines.append("stage graph (per-node critical vs overlapped seconds):")
+        for name, g in gnodes.items():
+            runs, skips = g.get("runs", 0), g.get("skips", 0)
+            if runs:
+                status = f"x{runs}"
+            elif skips:
+                status = "resume-skipped"
+            else:
+                status = "-"
+            lines.append(
+                f"  {name:28s} critical {g.get('critical_s', 0.0):8.3f}s  "
+                f"overlapped {g.get('overlapped_s', 0.0):8.3f}s  {status}"
+            )
+    gedges = graph.get("edges", {})
+    if gedges:
+        lines.append("graph edges (placement): " + ", ".join(
+            f"{name}[{placement}]" for name, placement in gedges.items()
+        ))
     disp = data.get("dispatch", {})
     if disp:
         lines.append("dispatch sites (host-gap vs blocked-on-device):")

@@ -166,7 +166,15 @@ class RunConfig:
     overlap_qc: bool = True  # run the error-profile passes on worker
     #   threads overlapped with round-1 polish / round-2 clustering
     #   (pipeline/overlap.py); artifacts stay byte-identical — False
-    #   restores the fully serial stage order
+    #   restores the fully serial stage order. Under executor="graph" this
+    #   only gates the worker pool: WHICH stages overlap is derived from
+    #   edge consumption in the stage graph (graph/pipeline.py)
+    executor: str = "graph"  # per-library scheduler: "graph" (default)
+    #   declares the round1→round2 pipeline as a typed dataflow graph
+    #   (graph/) and topologically executes it — placement-aware edges,
+    #   derived overlap, per-node watchdog/chaos/obs/resume attachment;
+    #   "imperative" keeps the hand-sequenced run.py path (kept one PR
+    #   for A/B; artifacts are byte-identical between the two)
     # --- robustness (robustness/; new, no reference analogue) ---
     retry_max_attempts: int = 3  # total attempts per dispatch site for
     #   TRANSIENT-classified failures (device/transport faults): 3 = one
@@ -345,6 +353,10 @@ class RunConfig:
             raise ValueError(
                 f"verify_resume={self.verify_resume!r} not in "
                 "('off', 'fast', 'full')"
+            )
+        if self.executor not in ("graph", "imperative"):
+            raise ValueError(
+                f"executor={self.executor!r} not in ('graph', 'imperative')"
             )
         if self.telemetry not in ("off", "on", "full"):
             raise ValueError(

@@ -35,6 +35,7 @@ import sys
 import numpy as np
 
 from ont_tcrconsensus_tpu.cluster import regions as regions_mod
+from ont_tcrconsensus_tpu.graph import executor as graph_exec
 from ont_tcrconsensus_tpu.io import bucketing, fastx, layout
 from ont_tcrconsensus_tpu.io import validate as validate_mod
 from ont_tcrconsensus_tpu.obs import device as obs_device
@@ -503,37 +504,28 @@ def _run_with_config_body(
     return results
 
 
-def _verify_resume_stage(lay, stage: str, cfg) -> bool:
-    """Gate a resume skip on artifact integrity (``verify_resume``).
-
-    True -> the stage's recorded artifacts check out (or checking is off):
-    safe to skip. False -> mismatch/unverifiable: the caller re-runs the
-    stage; the decision is recorded at the ``resume.verify`` site in
-    ``robustness_report.json`` so a silent-corruption recovery is an
-    auditable event, not a log line.
-    """
-    ok, why = lay.verify_stage(stage, cfg.verify_resume)
-    if ok:
-        return True
-    retry.recorder().record(
-        "resume.verify", classification="integrity", outcome="rerun",
-        error=why or "", detail={"library": lay.library, "stage": stage,
-                                 "mode": cfg.verify_resume},
-    )
-    _log(f"WARNING: resume verification failed for {lay.library} stage "
-         f"{stage!r} ({why}); re-running instead of trusting the artifact")
-    return False
+# Resume verification lives with the graph executor now (the imperative
+# path and the counts-level skip share the same gate); keep the local name
+# for its two call sites below.
+_verify_resume_stage = graph_exec.verify_resume_stage
 
 
 def _run_library(fastq, lay, cfg, panel, engine, engine_notrim,
                  blast_id_threshold, overlap_consensus, polisher,
                  read_batch, budget) -> dict[str, int]:
-    # Overlapped QC executor: error-profile passes run on worker threads
-    # concurrently with round-1 polish / round-2 clustering, committing
-    # their (byte-identical) log artifacts at fixed points before each
-    # round's resume checkpoint (pipeline/overlap.py, _commit_pending_qc).
+    # Overlapped executor: off-critical-path stages run on worker threads
+    # concurrently with polish / clustering, committing their
+    # (byte-identical) log artifacts at fixed points before each round's
+    # resume checkpoint (pipeline/overlap.py; under the graph executor the
+    # set of overlapped stages is derived from edge consumption).
     qc_exec = overlap.StageExecutor() if cfg.overlap_qc else None
     try:
+        if cfg.executor == "graph":
+            return _run_library_graph(
+                fastq, lay, cfg, panel, engine, engine_notrim,
+                blast_id_threshold, overlap_consensus, polisher,
+                read_batch, budget, qc_exec,
+            )
         return _run_library_impl(
             fastq, lay, cfg, panel, engine, engine_notrim,
             blast_id_threshold, overlap_consensus, polisher,
@@ -547,6 +539,31 @@ def _run_library(fastq, lay, cfg, panel, engine, engine_notrim,
             for name, exc in qc_exec.wait_all():
                 _log(f"WARNING: overlapped stage {name} also failed: {exc!r}")
         raise
+
+
+def _run_library_graph(fastq, lay, cfg, panel, engine, engine_notrim,
+                       blast_id_threshold, overlap_consensus, polisher,
+                       read_batch, budget, qc_exec) -> dict[str, int]:
+    """Declare the library graph and hand it to the graph executor.
+
+    Note what is NOT here: no overlap submissions, no commit points, no
+    resume probes, no per-stage timers or watchdog guards — the executor
+    derives all of that from the node/edge declarations
+    (graph/pipeline.py). This function only supplies the per-library
+    context the imperative path threaded positionally.
+    """
+    from ont_tcrconsensus_tpu.graph import pipeline as graph_pipeline
+
+    ctx = graph_pipeline.LibraryContext(
+        cfg=cfg, lay=lay, timer=StageTimer(), panel=panel, engine=engine,
+        engine_notrim=engine_notrim, blast_id_threshold=blast_id_threshold,
+        overlap_consensus=overlap_consensus, polisher=polisher,
+        read_batch=read_batch, budget=budget,
+    )
+    spec = graph_pipeline.build_library_graph(cfg)
+    executor = graph_exec.GraphExecutor(spec, ctx, side_exec=qc_exec)
+    results = executor.run({"library_fastq": fastq})
+    return results["region_counts"]
 
 
 def _commit_pending_qc(qc_exec, pending_qc, timer) -> None:

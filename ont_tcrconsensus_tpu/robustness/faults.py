@@ -22,6 +22,9 @@ site                     planted at
 ``run.round1_checkpoint`` immediately after the round-1 consensus
                          checkpoint commits (pipeline/run.py) — the
                          mid-stage ``kill`` / ``preempt`` site
+``graph.node``           every critical-path node body under the graph
+                         executor (graph/executor.py) — the per-node
+                         generalization of the hand-placed sites
 ======================== ====================================================
 
 Fault kinds:
@@ -95,6 +98,7 @@ KNOWN_SITES = frozenset({
     "run.round1_checkpoint",
     "ingest.library_fastq",
     "resume.verify",
+    "graph.node",
 })
 
 KILL_EXIT_CODE = 137

@@ -15,7 +15,9 @@
 # explicitly in CI output instead of drowning in the full run; pass
 # --no-chaos to skip it. Then: a telemetry smoke (tiny run at
 # telemetry=full — artifacts exist + validate, pipeline outputs
-# byte-identical to telemetry=off), the differential ingest fuzzer
+# byte-identical to telemetry=off), a graph-executor smoke (tiny workload
+# under executor=graph vs imperative — counts CSV + consensus FASTA
+# byte-identical, telemetry attributed per node), the differential ingest fuzzer
 # standalone (5 seeds), and a seeded-corpus replay through the ASan/UBSan
 # parser build (scripts/fuzz_ingest.py --sanitized; the >=1000-corpus
 # campaigns are the slow-marked tests).
@@ -89,6 +91,18 @@ trc=$?
 if [ "$trc" -ne 0 ]; then
     echo "telemetry smoke FAILED (rc=$trc)" >&2
     exit "$trc"
+fi
+
+echo "--- graph executor smoke (tiny workload under executor=graph vs"
+echo "    imperative: counts CSV + consensus FASTA byte-identical, telemetry"
+echo "    attributed per node) ---"
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/test_graph.py -q \
+    -k "graph_vs_imperative_byte_identity or attributes_telemetry_per_node" \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+grc=$?
+if [ "$grc" -ne 0 ]; then
+    echo "graph executor smoke FAILED (rc=$grc)" >&2
+    exit "$grc"
 fi
 
 echo "--- ingest fuzz smoke (native vs Python differential, 5 seeds) ---"

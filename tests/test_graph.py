@@ -1,0 +1,682 @@
+"""Stage dataflow graph (ISSUE 8): builder validation, executor semantics,
+A/B byte-identity vs the imperative path, and chaos recovery under
+``executor: graph``.
+
+Layout:
+
+- builder/spec unit tests — pure IR, no jax, milliseconds;
+- synthetic executor tests — real StageExecutor worker pool + real
+  watchdog/chaos/metrics layers over toy node fns, still no device work.
+  These prove the overlap GENERALIZATION: a stage runs off the critical
+  path because of its edge declaration alone, with zero executor or
+  run.py special-casing;
+- production-graph shape tests — ``build_library_graph`` under the config
+  knobs, jax-free by construction (the ``--validate`` story);
+- e2e on the simulator library — one graph-executor baseline shared by
+  the imperative A/B, a stall chaos run, and a corrupt-artifact resume.
+
+Synthetic graphs pass node names through VARIABLES, not literals: the
+graftlint graph/obs rules police string literals against the production
+registries (GRAPH_NODES / OBS_SITES), and fixture names are deliberately
+outside that vocabulary.
+"""
+
+import json
+import os
+import shutil
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from ont_tcrconsensus_tpu.graph import GRAPH_NODES
+from ont_tcrconsensus_tpu.graph.executor import GraphExecutor
+from ont_tcrconsensus_tpu.graph.ir import GraphBuilder, GraphValidationError
+from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
+from ont_tcrconsensus_tpu.qc.timing import StageTimer
+from ont_tcrconsensus_tpu.robustness import faults
+
+COUNTS_CSV = os.path.join("nano_tcr", "barcode01", "counts",
+                          "umi_consensus_counts.csv")
+MERGED_FASTA = os.path.join("nano_tcr", "barcode01", "fasta",
+                            "merged_consensus.fasta")
+
+# fixture node/edge names, held in variables so the literal-scoped lint
+# rules (graph-unknown-node / obs-unknown-site) stay out of test graphs
+N_LOAD, N_COMPUTE, N_QC, N_EXTRA, N_FINISH = (
+    "t-load", "t-compute", "t-qc", "t-extra", "t-finish")
+N_RESUME, N_TAIL = "t-resume", "t-tail"
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.disarm()
+    obs_metrics.disarm()
+
+
+def _ctx(**over):
+    d = dict(cfg=SimpleNamespace(resume=False), timer=StageTimer(), lay=None)
+    d.update(over)
+    return SimpleNamespace(**d)
+
+
+def _problems(excinfo) -> str:
+    return "\n".join(excinfo.value.problems)
+
+
+# ---------------------------------------------------------------------------
+# builder / spec units
+
+
+def _diamond(extra_sink: bool = False) -> GraphBuilder:
+    """src -> load -> compute -> finish, with qc (and optionally extra)
+    hanging off compute's output as pure side sinks."""
+    b = GraphBuilder("t")
+    b.input("src", "disk")
+    b.edge("x", "hbm")
+    b.edge("y", "host")
+    b.edge("q", "host")
+    b.edge("out", "host")
+    b.add_node(N_LOAD, lambda ctx, i: {"x": i["src"] * 2},
+               inputs=("src",), outputs=("x",))
+    b.add_node(N_COMPUTE, lambda ctx, i: {"y": i["x"] + 1},
+               inputs=("x",), outputs=("y",))
+    b.add_node(N_QC, lambda ctx, i: {"q": ("qc", i["y"])},
+               inputs=("y",), outputs=("q",))
+    if extra_sink:
+        b.edge("q2", "host")
+        b.add_node(N_EXTRA, lambda ctx, i: {"q2": ("extra", i["y"])},
+                   inputs=("y",), outputs=("q2",))
+    b.add_node(N_FINISH, lambda ctx, i: {"out": i["y"] * 10},
+               inputs=("y",), outputs=("out",))
+    b.result("out")
+    return b
+
+
+def test_builder_valid_graph_schedule_and_side_sinks():
+    spec = _diamond().build()
+    assert [n.name for n in spec.schedule] == [N_LOAD, N_COMPUTE, N_QC,
+                                              N_FINISH]
+    assert spec.side_sinks() == [N_QC]
+    assert spec.edges["x"].placement == "hbm"
+    d = spec.describe()
+    assert d["side_sinks"] == [N_QC] and d["results"] == ["out"]
+    assert d["edges"]["src"] == "disk"
+
+
+def test_builder_collects_every_problem_at_once():
+    b = GraphBuilder("bad")
+    b.input("src", "disk")
+    b.edge("w", "vram")                      # unknown placement
+    b.edge("lonely", "host")                 # dangling
+    b.add_node(N_LOAD, None, inputs=("src", "ghost"), outputs=("w", "w2"))
+    b.result("nope")
+    with pytest.raises(GraphValidationError) as exc:
+        b.build()
+    text = _problems(exc)
+    assert "unknown placement 'vram'" in text
+    assert "undeclared input edge 'ghost'" in text
+    assert "undeclared output edge 'w2'" in text
+    assert "'lonely' is dangling" in text
+    assert "result edge 'nope' is not declared" in text
+    assert len(exc.value.problems) >= 5
+    assert str(exc.value).startswith("invalid stage graph:")
+
+
+def test_builder_cycle_reported_with_member_names():
+    b = GraphBuilder("cyc")
+    b.edge("e1", "host")
+    b.edge("e2", "host")
+    b.add_node(N_LOAD, None, inputs=("e2",), outputs=("e1",))
+    b.add_node(N_COMPUTE, None, inputs=("e1",), outputs=("e2",))
+    b.result("e1")
+    with pytest.raises(GraphValidationError) as exc:
+        b.build()
+    (line,) = [p for p in exc.value.problems if "cycle" in p]
+    assert N_LOAD in line and N_COMPUTE in line
+
+
+def test_builder_duplicate_declarations_and_producer():
+    b = GraphBuilder("dup")
+    b.input("src", "disk")
+    b.edge("y", "host")
+    b.edge("y", "host")
+    b.add_node(N_LOAD, None, inputs=("src",), outputs=("y",))
+    b.add_node(N_LOAD, None, inputs=("src",), outputs=("y",))
+    b.add_node(N_COMPUTE, None, inputs=("src",), outputs=("y",))
+    b.result("y")
+    with pytest.raises(GraphValidationError) as exc:
+        b.build()
+    text = _problems(exc)
+    assert "edge 'y' declared twice" in text
+    assert f"node {N_LOAD!r} declared twice" in text
+    assert "produced by both" in text
+
+
+def _resume_chain(h_placement: str, provides=("e2",), reload_fn="default"):
+    """src -> load -> resume(disk artifact + crossing edge) -> tail."""
+    b = GraphBuilder("res")
+    b.input("src", "disk")
+    b.edge("e1", "host")
+    b.edge("d", "disk")
+    b.edge("e2", h_placement)
+    b.edge("out", "host")
+    b.edge("sq", "host")
+    b.add_node(N_LOAD, lambda ctx, i: {"e1": 1}, inputs=("src",),
+               outputs=("e1",))
+    b.add_node(N_QC, lambda ctx, i: {"sq": 2}, inputs=("e1",),
+               outputs=("sq",))
+    rl = (lambda ctx: {"e2": 42}) if reload_fn == "default" else reload_fn
+    b.add_node(N_RESUME, lambda ctx, i: {"d": "p", "e2": 42},
+               inputs=("e1",), outputs=("d", "e2"),
+               resume_key="rk", resume_reload=rl, resume_provides=provides)
+    b.add_node(N_TAIL, lambda ctx, i: {"out": i["e2"]}, inputs=("e2",),
+               outputs=("out",))
+    b.result("out")
+    return b
+
+
+def test_builder_rejects_hbm_edge_crossing_resume_boundary():
+    with pytest.raises(GraphValidationError) as exc:
+        _resume_chain("hbm").build()
+    assert any("device memory cannot survive a restart" in p
+               for p in exc.value.problems)
+
+
+def test_builder_rejects_unprovided_crossing_and_missing_reload():
+    with pytest.raises(GraphValidationError) as exc:
+        _resume_chain("host", provides=()).build()
+    assert any("reload does not provide it" in p for p in exc.value.problems)
+    with pytest.raises(GraphValidationError) as exc:
+        _resume_chain("host", reload_fn=None).build()
+    assert any("no resume_reload" in p for p in exc.value.problems)
+
+
+def test_builder_rejects_resume_node_without_disk_output():
+    b = GraphBuilder("nodisk")
+    b.input("src", "disk")
+    b.edge("e1", "host")
+    b.add_node(N_RESUME, None, inputs=("src",), outputs=("e1",),
+               resume_key="rk")
+    b.result("e1")
+    with pytest.raises(GraphValidationError) as exc:
+        b.build()
+    assert any("no disk-placed edge" in p for p in exc.value.problems)
+
+
+def test_spec_skip_closure_absorbs_only_side_sinks():
+    spec = _resume_chain("host").build()
+    # qc hangs off load (inside the closure) -> absorbed; tail consumes the
+    # resume node's provided edge from OUTSIDE the closure -> never absorbed
+    assert spec.skip_closure(N_RESUME) == {N_LOAD, N_QC, N_RESUME}
+    assert spec.crossing_edges(N_RESUME) == ["e2"]
+    assert spec.nodes[N_RESUME].checkpoint  # resume implies a barrier
+
+
+# ---------------------------------------------------------------------------
+# synthetic executor (real overlap pool / watchdog / chaos / metrics; no jax)
+
+
+def test_executor_runs_serially_without_side_pool():
+    spec = _diamond().build()
+    out = GraphExecutor(spec, _ctx()).run({"src": 3})
+    assert out == {"out": 70}
+
+
+def test_executor_rejects_missing_graph_input():
+    spec = _diamond().build()
+    with pytest.raises(ValueError, match="missing inputs"):
+        GraphExecutor(spec, _ctx()).run({})
+
+
+def test_executor_output_contract_enforced():
+    b = GraphBuilder("t")
+    b.input("src", "disk")
+    b.edge("y", "host")
+    b.add_node(N_LOAD, lambda ctx, i: {"wrong": 1}, inputs=("src",),
+               outputs=("y",))
+    b.result("y")
+    spec = b.build()
+    with pytest.raises(RuntimeError, match="returned edges"):
+        GraphExecutor(spec, _ctx()).run({"src": 0})
+
+
+def test_executor_overlaps_side_sinks_by_declaration_alone():
+    """The overlap generalization (ISSUE 8 acceptance): BOTH side sinks —
+    including one added purely by declaring an unconsumed output edge —
+    run on worker threads and commit on the main thread, with zero
+    overlap-specific code anywhere near the node bodies."""
+    from ont_tcrconsensus_tpu.pipeline.overlap import StageExecutor
+
+    b = _diamond(extra_sink=True)
+    spec = b.build()
+    assert spec.side_sinks() == [N_QC, N_EXTRA]
+
+    threads: dict[str, int] = {}
+    orig_qc, orig_extra = spec.nodes[N_QC].fn, spec.nodes[N_EXTRA].fn
+
+    def spy(name, fn):
+        def wrapped(ctx, i):
+            threads[name] = threading.get_ident()
+            time.sleep(0.02)  # a visible worker wall clock
+            return fn(ctx, i)
+        return wrapped
+
+    spec.nodes[N_QC].fn = spy(N_QC, orig_qc)
+    spec.nodes[N_EXTRA].fn = spy(N_EXTRA, orig_extra)
+    committed: list[int] = []
+    spec.nodes[N_EXTRA].commit = (
+        lambda ctx, outputs: committed.append(threading.get_ident()))
+
+    reg = obs_metrics.arm()
+    out = GraphExecutor(spec, _ctx(), side_exec=StageExecutor(2)).run(
+        {"src": 3})
+    assert out == {"out": 70}
+    main = threading.get_ident()
+    assert threads[N_QC] != main and threads[N_EXTRA] != main
+    assert committed == [main]  # commit hooks stay on the main thread
+    g = reg.summary()["graph"]
+    for name in (N_QC, N_EXTRA):
+        assert g["nodes"][name]["runs"] == 1
+        assert g["nodes"][name]["overlapped_s"] > 0
+    assert g["nodes"][N_COMPUTE]["overlapped_s"] == 0
+    assert g["edges"]["x"] == "hbm" and g["edges"]["src"] == "disk"
+
+
+def test_executor_recovers_dead_worker_on_main_thread():
+    """An overlapped worker dying mid-stage (chaos at overlap.worker)
+    surfaces at the commit barrier and is recomputed synchronously — the
+    artifact survives, only the overlap is lost."""
+    from ont_tcrconsensus_tpu.pipeline.overlap import StageExecutor
+
+    spec = _diamond().build()
+    faults.arm([{"site": "overlap.worker", "kind": "transient"}])
+    out = GraphExecutor(spec, _ctx(), side_exec=StageExecutor(2)).run(
+        {"src": 3})
+    assert out == {"out": 70}
+    assert faults.fired("overlap.worker") == 1
+
+
+def test_executor_chaos_site_fires_on_critical_node_bodies():
+    """Every critical node body shares the graph.node injection site — the
+    per-node generalization of the imperative hand-placed sites."""
+    spec = _diamond().build()
+    faults.arm([{"site": "graph.node", "kind": "transient"}])
+    with pytest.raises(faults.TransientChaosError):
+        GraphExecutor(spec, _ctx()).run({"src": 3})
+    assert faults.fired("graph.node") == 1
+
+
+def test_executor_resume_skips_closure_and_reloads_crossing_edges():
+    """With the resume node's manifest stage done+verified, its whole skip
+    closure is skipped (side sink included), crossing edges come from the
+    reload, and downstream still runs."""
+    ran: list[str] = []
+    spec = _resume_chain("host").build()
+    for name in (N_LOAD, N_QC, N_RESUME, N_TAIL):
+        orig = spec.nodes[name].fn
+
+        def wrapped(ctx, i, name=name, orig=orig):
+            ran.append(name)
+            return orig(ctx, i)
+
+        spec.nodes[name].fn = wrapped
+
+    class FakeLay:
+        library = "t"
+
+        def stage_done(self, key):
+            return key == "rk"
+
+        def verify_stage(self, key, mode):
+            return True, None
+
+    ctx = _ctx(cfg=SimpleNamespace(resume=True, verify_resume="fast"),
+               lay=FakeLay())
+    reg = obs_metrics.arm()
+    out = GraphExecutor(spec, ctx).run({"src": 0})
+    assert out == {"out": 42}  # 42 came from resume_reload, not the node fn
+    assert ran == [N_TAIL]
+    nodes = reg.summary()["graph"]["nodes"]
+    for skipped in (N_LOAD, N_QC, N_RESUME):
+        assert nodes[skipped] == {"critical_s": 0.0, "overlapped_s": 0.0,
+                                  "runs": 0, "skips": 1}
+    assert nodes[N_TAIL]["runs"] == 1
+
+
+# ---------------------------------------------------------------------------
+# production graph shape (jax-free — the --validate story)
+
+
+def _shape_cfg(**over):
+    from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+
+    d = {"reference_file": "ref.fa", "fastq_pass_dir": "fastq_pass"}
+    d.update(over)
+    return RunConfig.from_dict(d)
+
+
+def test_production_graph_matches_registry_and_derivations():
+    from ont_tcrconsensus_tpu.graph import pipeline as graph_pipeline
+
+    spec = graph_pipeline.build_library_graph(_shape_cfg())
+    assert {n.name for n in spec.schedule} == set(GRAPH_NODES)
+    assert spec.side_sinks() == [
+        "round1_error_profile", "write_region_fastas", "round2_error_profile"
+    ]
+    closure = spec.skip_closure("round1_consensus")
+    assert len(closure) == 8
+    assert "round1_error_profile" in closure and \
+        "write_region_fastas" in closure
+    assert not any(n.startswith("round2") for n in closure)
+    assert spec.crossing_edges("round1_consensus") == ["merged_consensus"]
+    for hbm_edge in ("read_store", "cons_store"):
+        assert spec.edges[hbm_edge].placement == "hbm"
+    for disk_edge in ("library_fastq", "merged_fasta", "counts_csv"):
+        assert spec.edges[disk_edge].placement == "disk"
+    assert spec.results == ("region_counts",)
+
+
+def test_production_graph_under_every_knob_combination():
+    from ont_tcrconsensus_tpu.graph import pipeline as graph_pipeline
+
+    sizes = {}
+    for sample in (512, 0):
+        for fastas in (True, False):
+            spec = graph_pipeline.build_library_graph(_shape_cfg(
+                error_profile_sample=sample,
+                write_intermediate_fastas=fastas,
+            ))
+            sizes[(bool(sample), fastas)] = len(spec.schedule)
+    assert sizes == {(True, True): 13, (True, False): 12,
+                     (False, True): 11, (False, False): 10}
+
+
+def test_graph_package_importable_without_jax():
+    """--validate must be able to build and reject graphs on a machine
+    with no accelerator stack: the graph package (and a full production
+    build) never imports jax at module scope."""
+    import subprocess
+    import sys
+
+    code = (
+        "import sys\n"
+        "from ont_tcrconsensus_tpu.graph import pipeline as gp\n"
+        "from ont_tcrconsensus_tpu.pipeline.config import RunConfig\n"
+        "cfg = RunConfig.from_dict({'reference_file': 'r.fa',"
+        " 'fastq_pass_dir': 'fq'})\n"
+        "spec = gp.build_library_graph(cfg)\n"
+        "assert len(spec.schedule) == 13\n"
+        "assert 'jax' not in sys.modules, 'graph build dragged in jax'\n"
+    )
+    subprocess.run([sys.executable, "-c", code], check=True,
+                   cwd=os.path.dirname(os.path.dirname(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# --validate and --report wiring (still jax-free)
+
+
+def _write_validate_inputs(root):
+    from ont_tcrconsensus_tpu.io import fastx
+
+    root.mkdir(parents=True, exist_ok=True)
+    fastx.write_fasta(root / "reference.fa", [("regA", "ACGT" * 200)])
+    fq = root / "fastq_pass" / "barcode01"
+    fq.mkdir(parents=True)
+    fastx.write_fastq(fq / "barcode01.fastq.gz",
+                      [("read1", "ACGT" * 200, "I" * 800)])
+    cfg_path = root / "config.json"
+    cfg_path.write_text(json.dumps({
+        "reference_file": str(root / "reference.fa"),
+        "fastq_pass_dir": str(root / "fastq_pass"),
+    }))
+    return cfg_path
+
+
+def test_validate_reports_graph_summary(tmp_path, capsys):
+    from ont_tcrconsensus_tpu.io.validate import validate_inputs
+
+    cfg_path = _write_validate_inputs(tmp_path)
+    assert validate_inputs(str(cfg_path)) == 0
+    out = capsys.readouterr().out
+    assert "validate: stage graph: 13 nodes" in out
+    assert "3 off-critical-path" in out
+
+
+def test_validate_skips_graph_for_imperative_executor(tmp_path, capsys):
+    from ont_tcrconsensus_tpu.io.validate import validate_inputs
+
+    cfg_path = _write_validate_inputs(tmp_path)
+    cfg = json.loads(cfg_path.read_text())
+    cfg["executor"] = "imperative"
+    cfg_path.write_text(json.dumps(cfg))
+    assert validate_inputs(str(cfg_path)) == 0
+    assert "stage graph" not in capsys.readouterr().out
+
+
+def test_validate_rejects_invalid_graph_with_named_problems(
+        tmp_path, capsys, monkeypatch):
+    from ont_tcrconsensus_tpu.graph import pipeline as graph_pipeline
+    from ont_tcrconsensus_tpu.io.validate import validate_inputs
+
+    cfg_path = _write_validate_inputs(tmp_path)
+
+    def broken(cfg):
+        raise GraphValidationError([
+            f"dependency cycle among nodes: {N_LOAD} -> {N_COMPUTE}",
+            "edge 'lonely' is dangling (declared but never produced "
+            "or consumed)",
+        ])
+
+    monkeypatch.setattr(graph_pipeline, "build_library_graph", broken)
+    assert validate_inputs(str(cfg_path)) == 1
+    out = capsys.readouterr().out
+    assert "stage graph: dependency cycle among nodes" in out
+    assert "stage graph: edge 'lonely' is dangling" in out
+    assert "FAIL" in out
+
+
+def test_report_renders_graph_section_without_jax():
+    from ont_tcrconsensus_tpu.obs import report as obs_report
+
+    lines: list[str] = []
+    obs_report._render_telemetry({
+        "telemetry": "full",
+        "duration_s": 1.0,
+        "graph": {
+            "nodes": {
+                "round1_polish": {"critical_s": 2.5, "overlapped_s": 0.0,
+                                  "runs": 1, "skips": 0},
+                "round1_error_profile": {"critical_s": 0.01,
+                                         "overlapped_s": 1.25,
+                                         "runs": 1, "skips": 0},
+                "round1_fused_assign": {"critical_s": 0.0,
+                                        "overlapped_s": 0.0,
+                                        "runs": 0, "skips": 1},
+            },
+            "edges": {"read_store": "hbm", "counts_csv": "disk"},
+        },
+    }, lines)
+    text = "\n".join(lines)
+    assert "stage graph (per-node critical vs overlapped seconds):" in text
+    assert "round1_error_profile" in text and "1.250s" in text
+    assert "resume-skipped" in text
+    assert "graph edges (placement): " in text
+    assert "counts_csv[disk]" in text and "read_store[hbm]" in text
+
+
+# ---------------------------------------------------------------------------
+# e2e on the simulator (shared baseline; ~seconds per run on the warm cache)
+
+
+@pytest.fixture(scope="module")
+def graph_lib(tmp_path_factory):
+    """Small simulated library + ONE graph-executor baseline run — the
+    byte-identity reference for the A/B and chaos scenarios."""
+    from ont_tcrconsensus_tpu.io import fastx, simulator
+
+    tmp = tmp_path_factory.mktemp("graph_e2e")
+    lib = simulator.simulate_library(
+        seed=11,
+        num_regions=2,
+        molecules_per_region=(2, 2),
+        reads_per_molecule=(5, 6),
+        sub_rate=0.006,
+        ins_rate=0.003,
+        del_rate=0.003,
+        region_len=(700, 850),
+    )
+    inputs = tmp / "inputs"
+    (inputs / "fastq_pass" / "barcode01").mkdir(parents=True)
+    fastx.write_fasta(inputs / "reference.fa", lib.reference.items())
+    fastx.write_fastq(
+        inputs / "fastq_pass" / "barcode01" / "barcode01.fastq.gz", lib.reads)
+    baseline = tmp / "baseline"
+    results, nano = _run_lib(inputs, baseline, executor="graph")
+    assert results["barcode01"] == lib.true_counts
+    return {
+        "tmp": tmp,
+        "inputs": inputs,
+        "lib": lib,
+        "baseline": baseline,
+        "baseline_nano": nano,
+        "baseline_counts": results["barcode01"],
+        "baseline_artifacts": _artifact_bytes(baseline),
+    }
+
+
+def _run_lib(inputs, root, **overrides):
+    from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+    from ont_tcrconsensus_tpu.pipeline.run import run_with_config
+
+    if not (root / "reference.fa").exists():
+        root.mkdir(parents=True, exist_ok=True)
+        shutil.copy(inputs / "reference.fa", root / "reference.fa")
+        shutil.copytree(inputs / "fastq_pass", root / "fastq_pass")
+    d = {
+        "reference_file": str(root / "reference.fa"),
+        "fastq_pass_dir": str(root / "fastq_pass"),
+        "minimal_length": 600,
+        "min_reads_per_cluster": 4,
+        "read_batch_size": 64,
+        "polish_method": "poa",
+        "delete_tmp_files": False,
+        "hbm_budget_gb": 12.0,
+        "retry_base_delay_s": 0.0,
+        # "on" writes telemetry.json (all the graph assertions need) without
+        # full-mode trace collection — keeps this module's wall time down
+        "telemetry": "on",
+    }
+    d.update(overrides)
+    return run_with_config(RunConfig.from_dict(d)), \
+        root / "fastq_pass" / "nano_tcr"
+
+
+def _artifact_bytes(root) -> dict[str, bytes]:
+    out = {}
+    for rel in (COUNTS_CSV, MERGED_FASTA):
+        path = root / "fastq_pass" / rel
+        assert path.exists(), f"missing artifact {rel}"
+        out[rel] = path.read_bytes()
+    return out
+
+
+def _assert_byte_identical(graph_lib, root):
+    got = _artifact_bytes(root)
+    for rel, want in graph_lib["baseline_artifacts"].items():
+        assert got[rel] == want, f"{rel} diverged from the graph baseline"
+
+
+def _telemetry(nano) -> dict:
+    return json.loads((nano / "telemetry.json").read_text())
+
+
+def test_graph_run_attributes_telemetry_per_node(graph_lib):
+    """ISSUE 8 acceptance: telemetry.json attributes spans/metrics per
+    node, and the QC profiles + region fastas ran overlapped without any
+    overlap-specific code in run.py (it is an edge-placement consequence)."""
+    tele = _telemetry(graph_lib["baseline_nano"])
+    g = tele["graph"]
+    assert set(g["nodes"]) == set(GRAPH_NODES)
+    for name, row in g["nodes"].items():
+        assert row["runs"] == 1 and row["skips"] == 0, name
+    for overlapped in ("round1_error_profile", "write_region_fastas",
+                      "round2_error_profile"):
+        assert g["nodes"][overlapped]["overlapped_s"] > 0, overlapped
+    assert g["nodes"]["round1_polish"]["overlapped_s"] == 0
+    assert g["edges"]["read_store"] == "hbm"
+    assert g["edges"]["counts_csv"] == "disk"
+    # the per-node spans feed the same stage table + TSV as before
+    tsv = (graph_lib["baseline_nano"] / "barcode01" / "logs" /
+           "stage_timing.tsv").read_text()
+    assert "round1_polish\t" in tsv
+    assert "write_region_fastas_bg\t" in tsv  # the worker's overlapped row
+
+
+def test_graph_vs_imperative_byte_identity(graph_lib, tmp_path):
+    """The serial A/B: executor=imperative produces byte-identical counts
+    CSV and consensus FASTA, and its telemetry keeps the pre-graph shape
+    (no "graph" section)."""
+    res, nano = _run_lib(graph_lib["inputs"], tmp_path / "imperative",
+                         executor="imperative")
+    assert res["barcode01"] == graph_lib["baseline_counts"]
+    _assert_byte_identical(graph_lib, tmp_path / "imperative")
+    assert "graph" not in _telemetry(nano)
+
+
+@pytest.mark.chaos
+def test_graph_chaos_stall_detected_and_recovered(graph_lib, tmp_path):
+    """A stall injected under the polish dispatch is cancelled by the
+    node-scoped watchdog guard (deadline scaled by the node's declared
+    units), retried, and the run stays byte-identical — under
+    executor: graph."""
+    root = tmp_path / "stall"
+    results, nano = _run_lib(graph_lib["inputs"], root, executor="graph",
+                             stage_timeout_s=6.0, chaos=[
+        {"site": "polish.dispatch", "kind": "stall"},
+    ])
+    assert results["barcode01"] == graph_lib["baseline_counts"]
+    assert faults.fired("polish.dispatch") == 1
+    _assert_byte_identical(graph_lib, root)
+    report = json.load(open(nano / "robustness_report.json"))
+    cancels = [e for e in report["events"]
+               if e["site"] == "watchdog.stall"
+               and e["outcome"] == "hard_cancel"]
+    assert any(e["detail"]["stage"] == "round1_polish" for e in cancels)
+    pol = report["sites"]["polish.dispatch"]["by_outcome"]
+    assert pol["retried"] >= 1 and pol["recovered"] >= 1
+    # the stall's wall time is attributed to the node that owned it
+    g = _telemetry(nano)["graph"]["nodes"]
+    assert g["round1_polish"]["critical_s"] >= 6.0
+
+
+@pytest.mark.chaos
+def test_graph_chaos_corrupt_counts_resumes_from_round1_node(
+        graph_lib, tmp_path):
+    """Corruption on the completed counts artifact fails full verification,
+    and the graph resume scan falls back to the round1_consensus resume
+    node: the whole round-1 closure (side sinks included) is skipped, the
+    crossing edge reloads from disk, round 2 recomputes byte-identical."""
+    root = tmp_path / "rot"
+    shutil.copytree(graph_lib["baseline"], root)
+    results, nano = _run_lib(graph_lib["inputs"], root, executor="graph",
+                             resume=True, verify_resume="full", chaos=[
+        {"site": "resume.verify", "kind": "corrupt-artifact"},
+    ])
+    assert faults.fired("resume.verify") == 1
+    assert results["barcode01"] == graph_lib["baseline_counts"]
+    _assert_byte_identical(graph_lib, root)
+    report = json.load(open(nano / "robustness_report.json"))
+    (ev,) = [e for e in report["events"] if e["site"] == "resume.verify"]
+    assert ev["outcome"] == "rerun" and ev["detail"]["stage"] == "counts"
+    g = _telemetry(nano)["graph"]["nodes"]
+    for skipped in ("round1_fused_assign", "round1_polish",
+                    "round1_error_profile", "write_region_fastas",
+                    "round1_consensus"):
+        assert g[skipped] == {"critical_s": 0.0, "overlapped_s": 0.0,
+                              "runs": 0, "skips": 1}, skipped
+    for ran in ("round2_fused_assign", "round2_counts"):
+        assert g[ran]["runs"] == 1 and g[ran]["skips"] == 0, ran

@@ -6,13 +6,14 @@ from tools.graftlint.rules import (
     chaos_sites,
     config_fields,
     exception_guard,
+    graph_sites,
     imports,
     jit_hygiene,
     obs_sites,
 )
 
 _MODULES = (jit_hygiene, exception_guard, chaos_sites, obs_sites,
-            config_fields, imports)
+            graph_sites, config_fields, imports)
 
 CHECKS = tuple(m.check for m in _MODULES)
 

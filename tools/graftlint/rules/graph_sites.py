@@ -1,0 +1,122 @@
+"""graph-node cross-checks vs the stage-graph registry (``graph.GRAPH_NODES``).
+
+The graph executor derives every per-node attachment from the node's
+declared name: the trace span / stage-timing row (``timer.stage(name)``),
+the watchdog guard (``watchdog.guard(name, ...)``), the telemetry
+``graph.nodes`` entry, and the ``f"{name}_bg"`` overlap span. A typo'd
+declaration therefore silently detaches a node from every dashboard and
+deadline at once. Mirroring the chaos-site rule, three directions,
+cross-file:
+
+- ``graph-unknown-node``      — a name literal passed to
+  ``GraphBuilder.add_node`` that is not a ``GRAPH_NODES`` entry;
+- ``graph-undeclared-node``   — a ``GRAPH_NODES`` entry never declared by
+  any ``add_node`` literal in the scanned tree (a node the vocabulary
+  promises but no graph builds);
+- ``graph-unattributed-node`` — a ``GRAPH_NODES`` entry missing from
+  ``obs.OBS_SITES``: the executor would emit that node's span/timer rows
+  under a name the obs rule does not police, so the heartbeat/timer
+  vocabulary and the graph vocabulary drift apart.
+
+Chaos coverage needs no per-node direction: every critical node body
+shares the single ``graph.node`` injection site and every overlapped node
+runs under ``overlap.worker`` — both policed by the chaos-site rule.
+
+The registry is read from the scanned files themselves — the
+``GRAPH_NODES = frozenset({...})`` assignment in ``graph/__init__.py``
+(its own name so the chaos rule, which collects every
+``KNOWN_SITES = ...`` literal, does not merge the vocabularies). With no
+definition in scope the checks no-op, so fixture trees lint quietly;
+test graphs passing node names through variables are out of scope by
+construction, exactly like the chaos rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.core import FileCtx, Finding, Project
+from tools.graftlint.rules import obs_sites
+
+RULES = {
+    "graph-unknown-node": "add_node name literal not in graph.GRAPH_NODES "
+                          "(node invisible to the graph-name vocabulary)",
+    "graph-undeclared-node": "GRAPH_NODES entry never declared by any "
+                             "add_node literal in the scanned tree",
+    "graph-unattributed-node": "GRAPH_NODES entry missing from "
+                               "obs.OBS_SITES — the executor's per-node "
+                               "spans/timers would be unpoliced",
+}
+
+_REGISTRY_NAME = "GRAPH_NODES"
+_PLANT_FUNC = "add_node"
+
+
+def known_nodes(project: Project) -> dict[str, tuple[str, int]]:
+    """{node: (path, line)} from every ``GRAPH_NODES = ...`` assignment
+    whose value contains string constants."""
+    nodes: dict[str, tuple[str, int]] = {}
+    for ctx in project.files:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == _REGISTRY_NAME
+                for t in node.targets
+            )):
+                continue
+            for const in ast.walk(node.value):
+                if isinstance(const, ast.Constant) and isinstance(const.value, str):
+                    nodes[const.value] = (ctx.path, const.lineno)
+    return nodes
+
+
+def _declare_calls(ctx: FileCtx) -> Iterator[tuple[ast.Call, str]]:
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None
+        )
+        if name != _PLANT_FUNC:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            yield node, first.value
+
+
+def check(project: Project) -> Iterator[Finding]:
+    known = known_nodes(project)
+    if not known:
+        return
+    declared: set[str] = set()
+    for ctx in project.files:
+        for node, name in _declare_calls(ctx):
+            declared.add(name)
+            if name not in known:
+                yield Finding(
+                    ctx.path, node.lineno, node.col_offset,
+                    "graph-unknown-node",
+                    f"node {name!r} is not in graph.GRAPH_NODES — its "
+                    "spans/guards/telemetry land under an unregistered "
+                    "name (typo?)",
+                )
+    for name, (path, line) in sorted(known.items()):
+        if name not in declared:
+            yield Finding(
+                path, line, 0, "graph-undeclared-node",
+                f"GRAPH_NODES entry {name!r} is declared by no add_node "
+                "call in the scanned tree — the vocabulary promises a "
+                "node nothing builds",
+            )
+    obs = obs_sites.known_sites(project)
+    if not obs:
+        return
+    for name, (path, line) in sorted(known.items()):
+        if name not in obs:
+            yield Finding(
+                path, line, 0, "graph-unattributed-node",
+                f"GRAPH_NODES entry {name!r} is missing from "
+                "obs.OBS_SITES — the executor's per-node span/timer/guard "
+                "names would escape the obs-site checks",
+            )

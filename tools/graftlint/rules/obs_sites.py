@@ -48,6 +48,10 @@ _PLANT_FUNCS = {
     "span", "instant",                      # obs.trace
     "dispatch", "timed_get",                # obs.device
     "stage",                                # qc.timing.StageTimer.stage
+    "add_node",                             # graph.ir.GraphBuilder — the
+    # executor derives span/timer names from the declared node name, so a
+    # declaration IS a telemetry plant (graph node names must be
+    # OBS_SITES entries; see rules/graph_sites.py)
 }
 
 _REGISTRY_NAME = "OBS_SITES"
