@@ -165,16 +165,24 @@ def assignment_accuracy(root: str, lib) -> float:
     return ok / n if n else 0.0
 
 
-def read_telemetry_summary(root: str) -> dict | None:
-    """Compact telemetry roll-up for the bench JSON line: per-site dispatch
-    counts + host-gap/block totals, compile count/seconds, HBM high-water
-    and peak host RSS — the numbers ROADMAP items 1 and 3 are blocked on,
-    committed with every capture (nano_tcr/telemetry.json, obs/report.py)."""
+def read_raw_telemetry(root: str) -> dict | None:
+    """The timed run's telemetry.json payload (None when absent/garbage)."""
     path = os.path.join(root, "fastq_pass", "nano_tcr", "telemetry.json")
     try:
         with open(path) as fh:
             tele = json.load(fh)
     except (OSError, ValueError):
+        return None
+    return tele if isinstance(tele, dict) else None
+
+
+def read_telemetry_summary(root: str) -> dict | None:
+    """Compact telemetry roll-up for the bench JSON line: per-site dispatch
+    counts + host-gap/block totals, compile count/seconds, HBM high-water
+    and peak host RSS — the numbers ROADMAP items 1 and 3 are blocked on,
+    committed with every capture (nano_tcr/telemetry.json, obs/report.py)."""
+    tele = read_raw_telemetry(root)
+    if tele is None:
         return None
     gauges = tele.get("gauges", {})
     return {
@@ -216,7 +224,37 @@ def emit(value: float, extra: dict | None = None) -> None:
     print(json.dumps(line))
 
 
-def main():
+def parse_args(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="End-to-end pipeline throughput bench (one JSON line)."
+    )
+    ap.add_argument(
+        "--ledger", default=os.environ.get("BENCH_HISTORY"),
+        help="cross-run history ledger (.jsonl) to append this capture to "
+        "(obs/history.py schema — the same entry run.py writes to "
+        "nano_tcr/history.jsonl); defaults to the BENCH_HISTORY env var",
+    )
+    ap.add_argument(
+        "--gate", action="store_true",
+        help="gate this capture against the ledger baseline "
+        "(scripts/perf_gate.py math: median + MAD over matching "
+        "fingerprint/backend/n_reads entries) and exit 1 on regression; "
+        "the capture is appended to the ledger either way",
+    )
+    ap.add_argument("--gate-threshold", type=float, default=0.15)
+    ap.add_argument("--gate-mad-k", type=float, default=4.0)
+    ap.add_argument("--gate-min-samples", type=int, default=3)
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.gate and not args.ledger:
+        print("bench: --gate needs a ledger (--ledger or BENCH_HISTORY)",
+              file=sys.stderr)
+        return 2
     # Probe FIRST so a dead backend yields a diagnosable artifact (rc=0,
     # "tpu_unavailable") instead of a stack trace after minutes of setup.
     # BENCH_FORCE_CPU=1 is a dev-only escape hatch for relative timing when
@@ -258,7 +296,7 @@ def main():
                 except (OSError, ValueError):
                     continue
         emit(0.0, extra)
-        return
+        return 0
 
     root = "/tmp/ont_tcr_bench"
     shutil.rmtree(root, ignore_errors=True)
@@ -274,7 +312,7 @@ def main():
 
         traceback.print_exc()
         emit(0.0, {"error": f"{type(exc).__name__}: {str(exc)[:200]}"})
-        return
+        return 0
 
     counts_ok = results.get("barcode01") == lib.true_counts
     acc = assignment_accuracy(root, lib)
@@ -296,6 +334,20 @@ def main():
         print(f"bench: count diffs (got, want): {diff}", file=sys.stderr)
     print(f"bench: stage timing {timing}", file=sys.stderr)
     emit_extra = {"n_reads": n_reads, "counts_exact": counts_ok}
+    # cross-run keys (obs/history.py): the committed BENCH_*.json line and
+    # the history ledger share one schema, so a capture file IS a valid
+    # baseline entry and trend scripts need no translation layer
+    import jax
+
+    from ont_tcrconsensus_tpu.obs import history as obs_history
+
+    backend = jax.default_backend()
+    fingerprint = obs_history.config_fingerprint(cfg)
+    sha = obs_history.git_sha()
+    emit_extra.update({
+        "backend": backend, "config_fingerprint": fingerprint,
+        "git_sha": sha,
+    })
     telemetry = read_telemetry_summary(root)
     if telemetry is not None:
         # dispatch-tax + recompile + memory HWM summary of the TIMED run
@@ -329,8 +381,36 @@ def main():
                 "ran overlapped off the critical path and are excluded "
                 "from the staged total.\n"
             )
+    rc = 0
+    entry = obs_history.build_entry(
+        "bench", read_raw_telemetry(root), fingerprint=fingerprint,
+        sha=sha, backend=backend, n_reads=n_reads,
+        reads_per_sec=round(reads_per_sec, 2),
+        extra={"counts_exact": counts_ok, "duration_s": round(dt, 3)},
+    )
+    if args.gate:
+        # gate BEFORE appending: the baseline is the ledger as it stood,
+        # never polluted by the entry under judgment
+        baseline, problems = obs_history.read_entries(args.ledger)
+        for p in problems:
+            print(f"bench: ledger {p}", file=sys.stderr)
+        result = obs_history.evaluate_gate(
+            baseline, entry, rel_threshold=args.gate_threshold,
+            mad_k=args.gate_mad_k, min_samples=args.gate_min_samples,
+        )
+        print(f"bench: perf gate {result.status.upper()} — {result.reason}",
+              file=sys.stderr)
+        if result.status == "fail":
+            rc = 1
+    if args.ledger:
+        try:
+            obs_history.append_entry(args.ledger, entry)
+        except OSError as exc:
+            print(f"bench: could not append to ledger {args.ledger}: "
+                  f"{exc!r}", file=sys.stderr)
     emit(reads_per_sec, emit_extra)
+    return rc
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -86,6 +86,11 @@ class GraphExecutor:
             raise ValueError(f"graph {spec.name!r}: missing inputs {missing}")
         for name in sorted(spec.edges):
             obs_metrics.graph_edge_set(name, spec.edges[name].placement)
+        for node in spec.schedule:
+            # declared structure into telemetry: obs/critical_path.py
+            # rebuilds the executed DAG (slack, what-if) from the artifact
+            obs_metrics.graph_node_declare(
+                node.name, inputs=node.inputs, outputs=node.outputs)
 
         skip, resume_node = self._resume_scan()
         values = dict(inputs)
@@ -108,6 +113,7 @@ class GraphExecutor:
                 continue
             node_inputs = {e: values[e] for e in node.inputs}
             units = node.eval_units(ctx, node_inputs)
+            obs_metrics.graph_node_declare(node.name, units=units)
             if self.side_exec is not None and spec.is_side_sink(node):
                 deferred = self.side_exec.submit(
                     node.name, node.fn, ctx, node_inputs, units=units,

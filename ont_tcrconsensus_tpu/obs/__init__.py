@@ -77,6 +77,8 @@ OBS_SITES = frozenset({
     "consensus.get",
     "polisher.get",
     "umi.distance",
+    # --- worker-pool busy/idle split (metrics.pool_add, overlap.py) ---
+    "overlap.pool",
     # --- instant events (trace.instant) ---
     "chaos.inject",
     "xla.compile",

@@ -85,10 +85,14 @@ def dispatch(site: str, bucket=None):
         elapsed = time.monotonic() - t0
         if frames and frames[-1] is frame:
             frames.pop()
+        # stage attribution: the innermost span label on THIS thread (a
+        # graph-node/stage name, or the worker's <name>_bg) — the per-node
+        # dispatch-tax rollup obs/critical_path.py joins against
         reg.dispatch_add(
             site, dispatches=1,
             host_s=max(elapsed - frame.block_s, 0.0),
             block_s=frame.block_s,
+            stage=trace.current_label(),
         )
 
 
@@ -105,10 +109,13 @@ def timed_get(site: str, value):
     dt = time.monotonic() - t0
     frames = getattr(_tls, "frames", None)
     if frames:
+        # blocked seconds flow to the enclosing frame, whose dispatch exit
+        # carries the stage attribution; only the get count lands here
         frames[-1].block_s += dt
-        reg.dispatch_add(site, gets=1)
+        reg.dispatch_add(site, gets=1, stage=trace.current_label())
     else:
-        reg.dispatch_add(site, gets=1, block_s=dt)
+        reg.dispatch_add(site, gets=1, block_s=dt,
+                         stage=trace.current_label())
     return out
 
 

@@ -1,0 +1,341 @@
+"""Cross-run history ledger: append-only per-run perf/telemetry entries.
+
+Within-run telemetry (telemetry.json) answers *where this run spent its
+time*; it cannot answer *did the last change help* — every run's numbers
+evaporate with the run directory. This module persists one compact JSON
+line per run into an append-only ledger:
+
+- ``<nano_tcr>/history.jsonl`` — always, from the run roll-up path
+  (pipeline/run.py) whenever telemetry is armed, and from ``bench.py``;
+- an opt-in cross-run ledger (``history_ledger`` config knob /
+  ``bench.py --ledger``, conventionally ``BENCH_HISTORY.jsonl`` at the
+  repo root) — the baseline pool ``scripts/perf_gate.py`` gates against.
+
+Entries are keyed by **git sha** (what code ran), **config fingerprint**
+(a stable hash of the resolved RunConfig minus pure filesystem-location
+keys — the same workload run from a different directory must land in the
+same baseline pool), **backend** and **n_reads** (what workload ran on
+what hardware). The gate compares a run only against entries agreeing on
+fingerprint/backend/n_reads, using median + MAD so one noisy historical
+sample cannot fail a healthy run.
+
+Contracts, matching the repo's artifact discipline:
+
+- **never-crash**: :func:`read_entries` degrades garbage/torn lines to
+  named problems and keeps the readable rest; :func:`record_run` never
+  fails the run it records.
+- **bounded**: :func:`append_entry` rotates the file down to the newest
+  ``max_entries`` lines, so a long-lived ledger cannot grow unbounded.
+- **jax-free**: nothing here imports jax (:func:`detect_backend` only
+  reads an already-imported module), so ``--report`` and the perf gate
+  stay safe on hosts with a wedged device tunnel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import time
+
+SCHEMA_VERSION = 1
+HISTORY_BASENAME = "history.jsonl"
+DEFAULT_MAX_ENTRIES = 512
+
+#: resolved-config keys excluded from the fingerprint: pure filesystem
+#: locations (and the ledger path itself) never change the computation,
+#: only where it reads/writes — two runs of one workload from different
+#: directories or machines must share a baseline pool
+FINGERPRINT_EXCLUDED_KEYS = frozenset({
+    "reference_file",
+    "fastq_pass_dir",
+    "nanopore_tcr_seq_primers_fasta",
+    "profile_trace_dir",
+    "history_ledger",
+})
+
+#: MAD -> sigma-equivalent scale for normally-distributed noise
+MAD_SCALE = 1.4826
+
+
+# --- keys ---------------------------------------------------------------------
+
+
+def config_fingerprint(cfg) -> str:
+    """Stable hash of the resolved config (RunConfig or plain dict).
+
+    Every perf-relevant knob participates (batch sizes, executor, chaos,
+    polish method, ...); only the :data:`FINGERPRINT_EXCLUDED_KEYS` path
+    knobs are dropped. 16 hex chars: collision-safe for a ledger, short
+    enough to eyeball-diff in a JSON line.
+    """
+    d = cfg if isinstance(cfg, dict) else cfg.to_dict()
+    d = {k: v for k, v in d.items() if k not in FINGERPRINT_EXCLUDED_KEYS}
+    blob = json.dumps(d, sort_keys=True, separators=(",", ":"), default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def git_sha(cwd: str | None = None) -> str | None:
+    """Best-effort ``git rev-parse HEAD`` of the package's repo (or
+    ``cwd``); None outside a repo / without git — never raises."""
+    try:
+        proc = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=5.0,
+            cwd=cwd or os.path.dirname(os.path.abspath(__file__)),
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if proc.returncode != 0:
+        return None
+    return proc.stdout.strip() or None
+
+
+def detect_backend() -> str | None:
+    """The active jax backend WITHOUT importing jax: reads the module only
+    when the calling process already loaded it (run/bench paths), so the
+    jax-free consumers (--report, perf_gate) stay jax-free."""
+    mod = sys.modules.get("jax")
+    if mod is None:
+        return None
+    try:
+        return str(mod.default_backend())
+    except Exception:
+        return None
+
+
+# --- entries ------------------------------------------------------------------
+
+
+def build_entry(source: str, telemetry: dict | None = None, *,
+                fingerprint: str | None = None, sha: str | None = None,
+                backend: str | None = None, n_reads: int | None = None,
+                reads_per_sec: float | None = None,
+                extra: dict | None = None) -> dict:
+    """One ledger entry. ``telemetry`` is a telemetry.json-shaped summary
+    (obs.metrics.MetricsRegistry.summary()); the entry keeps only the
+    trend-worthy roll-up, not the full per-site tables."""
+    entry: dict = {
+        "schema": SCHEMA_VERSION,
+        "t_wall": round(time.time(), 3),
+        "source": source,
+        "git_sha": sha,
+        "fingerprint": fingerprint,
+        "backend": backend,
+        "n_reads": n_reads,
+        "reads_per_sec": reads_per_sec,
+    }
+    if telemetry:
+        disp = telemetry.get("dispatch") or {}
+        comp = telemetry.get("compile") or {}
+        gauges = telemetry.get("gauges") or {}
+        entry.update({
+            "duration_s": telemetry.get("duration_s"),
+            "stages": {
+                k: v.get("seconds")
+                for k, v in (telemetry.get("stages") or {}).items()
+                if isinstance(v, dict)
+            },
+            "dispatch_host_s": round(sum(
+                d.get("host_s", 0.0) for d in disp.values()
+                if isinstance(d, dict)
+            ), 3),
+            "dispatch_block_s": round(sum(
+                d.get("block_s", 0.0) for d in disp.values()
+                if isinstance(d, dict)
+            ), 3),
+            "compile_count": comp.get("count", 0),
+            "compile_s": comp.get("seconds", 0.0),
+            "hbm_high_water_bytes": gauges.get("device.hbm_bytes_in_use"),
+            "peak_host_rss_bytes": gauges.get("host.rss_bytes"),
+        })
+    if extra:
+        entry.update(extra)
+    return entry
+
+
+def append_entry(path: str, entry: dict,
+                 max_entries: int = DEFAULT_MAX_ENTRIES) -> None:
+    """Append one JSON line; rotate down to the newest ``max_entries``."""
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    with open(path, "a") as fh:
+        fh.write(json.dumps(entry, sort_keys=True,
+                            separators=(",", ":")) + "\n")
+    _rotate(path, max_entries)
+
+
+def _rotate(path: str, max_entries: int) -> None:
+    try:
+        with open(path) as fh:
+            lines = fh.readlines()
+    except OSError:
+        return
+    if len(lines) <= max_entries:
+        return
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.writelines(lines[-max_entries:])
+    os.replace(tmp, path)
+
+
+def read_entries(path: str) -> tuple[list[dict], list[str]]:
+    """(entries, problems). Garbage/torn lines become named problems and
+    are dropped; the readable rest survives — a half-written final line
+    (the process died mid-append) must not take the whole history down."""
+    try:
+        with open(path) as fh:
+            raw = fh.read()
+    except OSError as exc:
+        return [], [f"unreadable ledger {path}: {exc!r}"]
+    entries: list[dict] = []
+    problems: list[str] = []
+    for i, line in enumerate(raw.splitlines(), 1):
+        if not line.strip():
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            problems.append(f"line {i}: not valid JSON (torn or garbage "
+                            "entry dropped)")
+            continue
+        if not isinstance(obj, dict):
+            problems.append(f"line {i}: not a JSON object (dropped)")
+            continue
+        entries.append(obj)
+    return entries, problems
+
+
+# --- the regression gate ------------------------------------------------------
+
+
+@dataclasses.dataclass
+class GateResult:
+    """Outcome of one gate evaluation; ``status`` drives the exit code
+    (fail -> nonzero), everything else is the explanation."""
+
+    status: str  # "pass" | "warn" | "fail"
+    reason: str
+    metric: str | None = None
+    current: float | None = None
+    baseline_median: float | None = None
+    baseline_mad: float | None = None
+    allowance: float | None = None
+    n_baseline: int = 0
+
+
+def matching_entries(entries: list[dict], current: dict) -> list[dict]:
+    """Baseline pool: entries agreeing with ``current`` on fingerprint,
+    backend and n_reads (``current`` itself excluded by identity, so
+    gating the ledger's own latest entry works)."""
+    keys = ("fingerprint", "backend", "n_reads")
+    return [e for e in entries
+            if e is not current
+            and all(e.get(k) == current.get(k) for k in keys)]
+
+
+def _median(values: list[float]) -> float:
+    s = sorted(values)
+    mid = len(s) // 2
+    return s[mid] if len(s) % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def _metric_of(entry: dict) -> tuple[str | None, float | None]:
+    """Preferred gate metric of one entry: reads_per_sec (higher better,
+    bench entries) else duration_s (lower better, run entries)."""
+    for name in ("reads_per_sec", "duration_s"):
+        v = entry.get(name)
+        if isinstance(v, (int, float)) and not isinstance(v, bool) and v > 0:
+            return name, float(v)
+    return None, None
+
+
+def evaluate_gate(entries: list[dict], current: dict, *,
+                  rel_threshold: float = 0.15, mad_k: float = 4.0,
+                  min_samples: int = 3) -> GateResult:
+    """Noise-aware regression verdict for ``current`` vs the ledger.
+
+    The allowance is ``max(rel_threshold * median, mad_k * 1.4826 * MAD)``
+    over the matching baseline samples: a quiet baseline gates at the
+    relative threshold, a noisy one widens to what its own scatter
+    justifies — one flaky historical sample cannot fail a healthy run,
+    and a machine with inherently noisy timings self-calibrates. Fewer
+    than ``min_samples`` usable baselines -> ``warn`` (recorded, not
+    gated): a thin ledger must not fail CI on a fresh machine.
+    """
+    mname, cur = _metric_of(current)
+    if mname is None:
+        return GateResult(
+            "warn", "current entry has no usable metric "
+            "(reads_per_sec/duration_s missing or non-positive) — not gated",
+        )
+    values = [v for e in matching_entries(entries, current)
+              for name, v in (_metric_of(e),) if name == mname]
+    if len(values) < min_samples:
+        return GateResult(
+            "warn",
+            f"thin ledger: {len(values)} matching baseline sample(s) < "
+            f"min_samples={min_samples} — recorded, not gated",
+            metric=mname, current=cur, n_baseline=len(values),
+        )
+    med = _median(values)
+    mad = _median([abs(v - med) for v in values])
+    allowance = max(rel_threshold * med, mad_k * MAD_SCALE * mad)
+    if mname == "duration_s":
+        regressed = cur > med + allowance
+        side = "above"
+    else:
+        regressed = cur < med - allowance
+        side = "below"
+    detail = (f"{mname}={cur:.3f} vs baseline median {med:.3f} "
+              f"(MAD {mad:.3f}, allowance {allowance:.3f}, "
+              f"{len(values)} sample(s))")
+    if regressed:
+        return GateResult(
+            "fail", f"regression: {detail} — current is {side} the "
+            "noise allowance", metric=mname, current=cur,
+            baseline_median=med, baseline_mad=mad, allowance=allowance,
+            n_baseline=len(values),
+        )
+    return GateResult(
+        "pass", f"within noise allowance: {detail}", metric=mname,
+        current=cur, baseline_median=med, baseline_mad=mad,
+        allowance=allowance, n_baseline=len(values),
+    )
+
+
+# --- the run roll-up hook -----------------------------------------------------
+
+
+def record_run(nano_dir: str, cfg, *, suffix: str = "") -> dict | None:
+    """Append this run's entry to ``<nano_dir>/history<suffix>.jsonl``
+    (plus ``cfg.history_ledger`` when set) from the armed registry.
+
+    Called from the run roll-up finally-block right after the telemetry
+    write; like every telemetry path it must never fail the run it
+    records — any trouble degrades to a stderr warning.
+    """
+    try:
+        from ont_tcrconsensus_tpu.obs import metrics
+
+        reg = metrics.registry()
+        if reg is None:
+            return None
+        entry = build_entry(
+            "run", reg.summary(),
+            fingerprint=config_fingerprint(cfg),
+            sha=git_sha(), backend=detect_backend(),
+        )
+        name = f"history{suffix}.jsonl" if suffix else HISTORY_BASENAME
+        append_entry(os.path.join(nano_dir, name), entry)
+        ledger = getattr(cfg, "history_ledger", None)
+        if ledger:
+            append_entry(ledger, entry)
+        return entry
+    except Exception as exc:
+        sys.stderr.write(
+            f"WARNING: could not append run-history entry: {exc!r}\n")
+        return None

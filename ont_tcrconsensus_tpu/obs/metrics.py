@@ -51,6 +51,16 @@ class MetricsRegistry:
         self.graph_nodes: dict[str, list[float]] = {}
         # graph edge -> placement ("hbm" | "host" | "disk")
         self.graph_edges: dict[str, str] = {}
+        # graph node -> {"inputs": [...], "outputs": [...], "units": int}
+        # (declared structure; lets obs/critical_path.py rebuild the DAG
+        # from the artifact alone)
+        self.graph_meta: dict[str, dict] = {}
+        # pool site -> [busy_s, idle_s, window_s, slots]
+        self.pools: dict[str, list[float]] = {}
+        # stage label -> [n_dispatch, n_get, host_s, block_s] (the
+        # dispatch-tax split re-keyed by the active stage span, so the
+        # per-node rollup needs no trace replay)
+        self.dispatch_stages: dict[str, list[float]] = {}
 
     # --- update API (called via the module-level wrappers) -----------------
 
@@ -84,13 +94,20 @@ class MetricsRegistry:
                 s[1] += 1
 
     def dispatch_add(self, site: str, *, dispatches: int = 0, gets: int = 0,
-                     host_s: float = 0.0, block_s: float = 0.0) -> None:
+                     host_s: float = 0.0, block_s: float = 0.0,
+                     stage: str | None = None) -> None:
         with self._lock:
             d = self.dispatch.setdefault(site, [0, 0, 0.0, 0.0])
             d[0] += dispatches
             d[1] += gets
             d[2] += host_s
             d[3] += block_s
+            if stage is not None:
+                s = self.dispatch_stages.setdefault(stage, [0, 0, 0.0, 0.0])
+                s[0] += dispatches
+                s[1] += gets
+                s[2] += host_s
+                s[3] += block_s
 
     def compile_add(self, label: str, seconds: float) -> None:
         with self._lock:
@@ -114,6 +131,28 @@ class MetricsRegistry:
     def graph_edge_set(self, name: str, placement: str) -> None:
         with self._lock:
             self.graph_edges[name] = placement
+
+    def graph_node_declare(self, name: str, *, inputs=None, outputs=None,
+                           units: int | None = None) -> None:
+        """Record a node's declared structure (dependency edges) and its
+        evaluated workload units (summed over runs, like the seconds)."""
+        with self._lock:
+            m = self.graph_meta.setdefault(name, {})
+            if inputs is not None:
+                m["inputs"] = list(inputs)
+            if outputs is not None:
+                m["outputs"] = list(outputs)
+            if units is not None:
+                m["units"] = m.get("units", 0) + int(units)
+
+    def pool_add(self, site: str, *, busy_s: float = 0.0, idle_s: float = 0.0,
+                 window_s: float = 0.0, slots: int = 0) -> None:
+        with self._lock:
+            p = self.pools.setdefault(site, [0.0, 0.0, 0.0, 0])
+            p[0] += busy_s
+            p[1] += idle_s
+            p[2] += window_s
+            p[3] = max(p[3], slots)
 
     # --- roll-up -----------------------------------------------------------
 
@@ -153,19 +192,49 @@ class MetricsRegistry:
                     for k, v in sorted(self.hists.items())
                 },
             }
+            if self.dispatch_stages:
+                out["dispatch_by_stage"] = {
+                    k: {"dispatches": int(v[0]), "gets": int(v[1]),
+                        "host_s": round(v[2], 3), "block_s": round(v[3], 3)}
+                    for k, v in sorted(self.dispatch_stages.items())
+                }
+            pool = None
+            if self.pools:
+                # one merged busy/idle split (a run has one overlap pool
+                # vocabulary entry; summing stays correct if more appear)
+                pool = {
+                    "busy_s": round(sum(p[0] for p in self.pools.values()), 3),
+                    "idle_s": round(sum(p[1] for p in self.pools.values()), 3),
+                    "window_s": round(
+                        sum(p[2] for p in self.pools.values()), 3),
+                    "slots": max(int(p[3]) for p in self.pools.values()),
+                }
             # graph-executor section: present only when a graph actually
             # ran, so imperative-path telemetry keeps its exact shape
             if self.graph_nodes or self.graph_edges:
+                gnodes = {}
+                for k in sorted(set(self.graph_nodes) | set(self.graph_meta)):
+                    v = self.graph_nodes.get(k, [0.0, 0.0, 0, 0])
+                    entry = {"critical_s": round(v[0], 3),
+                             "overlapped_s": round(v[1], 3),
+                             "runs": int(v[2]), "skips": int(v[3])}
+                    meta = self.graph_meta.get(k)
+                    if meta:
+                        entry["units"] = int(meta.get("units", 0))
+                        entry["inputs"] = list(meta.get("inputs", ()))
+                        entry["outputs"] = list(meta.get("outputs", ()))
+                    gnodes[k] = entry
                 out["graph"] = {
-                    "nodes": {
-                        k: {"critical_s": round(v[0], 3),
-                            "overlapped_s": round(v[1], 3),
-                            "runs": int(v[2]), "skips": int(v[3])}
-                        for k, v in sorted(self.graph_nodes.items())
-                    },
+                    "nodes": gnodes,
                     "edges": {k: self.graph_edges[k]
                               for k in sorted(self.graph_edges)},
                 }
+                if pool is not None:
+                    out["graph"]["pool"] = pool
+            elif pool is not None:
+                # imperative executor with overlap_qc: no graph section to
+                # host the pool split, so it rides top-level
+                out["overlap_pool"] = pool
             return out
 
 
@@ -236,3 +305,22 @@ def graph_edge_set(name: str, placement: str) -> None:
     reg = _ARMED
     if reg is not None:
         reg.graph_edge_set(name, placement)
+
+
+def graph_node_declare(name: str, *, inputs=None, outputs=None,
+                       units: int | None = None) -> None:
+    """Record a graph node's declared edges / evaluated workload units
+    into the telemetry graph section; free no-op when telemetry is off."""
+    reg = _ARMED
+    if reg is not None:
+        reg.graph_node_declare(name, inputs=inputs, outputs=outputs,
+                               units=units)
+
+
+def pool_add(site: str, *, busy_s: float = 0.0, idle_s: float = 0.0,
+             window_s: float = 0.0, slots: int = 0) -> None:
+    """Record a worker pool's busy/idle split; free no-op when off."""
+    reg = _ARMED
+    if reg is not None:
+        reg.pool_add(site, busy_s=busy_s, idle_s=idle_s, window_s=window_s,
+                     slots=slots)

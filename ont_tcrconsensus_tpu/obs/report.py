@@ -17,6 +17,8 @@ import glob
 import json
 import os
 
+from ont_tcrconsensus_tpu.obs import critical_path as critical_path_mod
+from ont_tcrconsensus_tpu.obs import history as history_mod
 from ont_tcrconsensus_tpu.obs import metrics, trace
 
 TELEMETRY_BASENAME = "telemetry.json"
@@ -108,10 +110,19 @@ def _render_telemetry(data: dict, lines: list[str]) -> None:
                 status = "resume-skipped"
             else:
                 status = "-"
+            units = g.get("units")
             lines.append(
                 f"  {name:28s} critical {g.get('critical_s', 0.0):8.3f}s  "
                 f"overlapped {g.get('overlapped_s', 0.0):8.3f}s  {status}"
+                + (f"  ({units} units)" if units else "")
             )
+    pool = graph.get("pool") or data.get("overlap_pool")
+    if pool:
+        lines.append(
+            f"overlap pool: busy {pool.get('busy_s', 0.0):.3f}s idle "
+            f"{pool.get('idle_s', 0.0):.3f}s across {pool.get('slots')} "
+            "worker slot(s)"
+        )
     gedges = graph.get("edges", {})
     if gedges:
         lines.append("graph edges (placement): " + ", ".join(
@@ -146,9 +157,13 @@ def _render_telemetry(data: dict, lines: list[str]) -> None:
         lines.append("robustness events: none")
 
 
-def render_report(nano_dir: str) -> tuple[str, int]:
+def render_report(nano_dir: str, critical_path: bool = False) -> tuple[str, int]:
     """(report text, exit code) from the committed artifacts in
-    ``nano_dir``. Exit 1 when no telemetry artifact exists."""
+    ``nano_dir``. Exit 1 when no telemetry artifact exists. With
+    ``critical_path``, each telemetry artifact's executed-graph section is
+    additionally run through :mod:`obs.critical_path` (slack / what-if /
+    pool efficiency; analysis problems are informational — they name what
+    the artifact cannot support, without failing the report)."""
     lines = [f"run report: {nano_dir}"]
     tele_paths = sorted(glob.glob(os.path.join(nano_dir, "telemetry*.json")))
     tele_paths = [p for p in tele_paths if not p.endswith(".tmp")]
@@ -189,11 +204,15 @@ def render_report(nano_dir: str) -> tuple[str, int]:
             rc = 1
             continue
         trace_rel = data.get("trace_json")
+        trace_payload = None
         if isinstance(trace_rel, str) and trace_rel:
             tpath = os.path.join(nano_dir, trace_rel)
             try:
                 with open(tpath) as fh:
-                    n_events = len(json.load(fh).get("traceEvents", []))
+                    trace_payload = json.load(fh)
+                if not isinstance(trace_payload, dict):
+                    trace_payload = None
+                n_events = len((trace_payload or {}).get("traceEvents", []))
                 lines.append(f"trace: {trace_rel} ({n_events} events; open "
                              "in chrome://tracing or Perfetto)")
             except (OSError, ValueError) as exc:
@@ -201,6 +220,10 @@ def render_report(nano_dir: str) -> tuple[str, int]:
                 rc = 1
         else:
             lines.append("trace: none (telemetry=full records one)")
+        if critical_path:
+            lines.append("-- critical path --")
+            critical_path_mod.render(
+                critical_path_mod.analyze(data, trace_payload), lines)
     for rpath in sorted(glob.glob(
         os.path.join(nano_dir, "robustness_report*.json")
     )):
@@ -222,19 +245,120 @@ def render_report(nano_dir: str) -> tuple[str, int]:
     if tsvs:
         lines.append(f"per-library stage timing: {len(tsvs)} "
                      "stage_timing.tsv file(s)")
+    for hpath in sorted(glob.glob(os.path.join(nano_dir, "history*.jsonl"))):
+        entries, problems = history_mod.read_entries(hpath)
+        lines.append(
+            f"run history: {len(entries)} entrie(s) in "
+            f"{os.path.basename(hpath)}"
+            + (f", {len(problems)} garbage line(s) skipped" if problems
+               else "")
+        )
     return "\n".join(lines) + "\n", rc
 
 
-def report_main(target: str) -> int:
+def collect_report(nano_dir: str, critical_path: bool = False
+                   ) -> tuple[dict, int]:
+    """Machine-readable twin of :func:`render_report` (``--report --json``).
+
+    Same resolution rules and exit codes: each telemetry artifact is
+    validated through the text renderer's own code path (into a discarded
+    scratch buffer), so a valid-JSON-but-garbage artifact yields the same
+    named problem + exit 1 in both modes instead of laundering garbage
+    into a clean-looking JSON dump.
+    """
+    out: dict = {"nano_dir": nano_dir, "problems": [], "telemetry": {}}
+    rc = 0
+    tele_paths = sorted(glob.glob(os.path.join(nano_dir, "telemetry*.json")))
+    tele_paths = [p for p in tele_paths if not p.endswith(".tmp")]
+    if not tele_paths:
+        out["problems"].append(
+            "no telemetry*.json found — the run predates the telemetry "
+            "layer, ran with telemetry=off, or died before roll-up")
+        rc = 1
+    if critical_path:
+        out["critical_path"] = {}
+    for path in tele_paths:
+        base = os.path.basename(path)
+        try:
+            with open(path) as fh:
+                data = json.load(fh)
+        except (OSError, ValueError) as exc:
+            out["problems"].append(f"unreadable {base}: {exc!r}")
+            rc = 1
+            continue
+        if not isinstance(data, dict):
+            out["problems"].append(
+                f"malformed telemetry artifact {base}: not a JSON object")
+            rc = 1
+            continue
+        try:
+            _render_telemetry(data, [])  # schema check, text discarded
+        except Exception as exc:
+            out["problems"].append(
+                f"malformed telemetry artifact {base}: {exc!r}")
+            rc = 1
+            continue
+        out["telemetry"][base] = data
+        trace_rel = data.get("trace_json")
+        trace_payload = None
+        if isinstance(trace_rel, str) and trace_rel:
+            try:
+                with open(os.path.join(nano_dir, trace_rel)) as fh:
+                    trace_payload = json.load(fh)
+                if not isinstance(trace_payload, dict):
+                    trace_payload = None
+            except (OSError, ValueError) as exc:
+                out["problems"].append(f"trace {trace_rel} unreadable "
+                                       f"({exc!r})")
+                rc = 1
+        if critical_path:
+            out["critical_path"][base] = critical_path_mod.analyze(
+                data, trace_payload)
+    robustness: dict = {}
+    for rpath in sorted(glob.glob(
+        os.path.join(nano_dir, "robustness_report*.json")
+    )):
+        base = os.path.basename(rpath)
+        try:
+            with open(rpath) as fh:
+                rep = json.load(fh)
+            robustness[base] = {"events": len(rep.get("events") or []),
+                                "chaos": bool(rep.get("chaos"))}
+        except (OSError, ValueError, AttributeError, TypeError):
+            robustness[base] = {"problem": "unreadable"}
+    out["robustness_reports"] = robustness
+    out["stage_timing_tsvs"] = len(glob.glob(
+        os.path.join(nano_dir, "*", "logs", "stage_timing.tsv")))
+    hist: dict = {}
+    for hpath in sorted(glob.glob(os.path.join(nano_dir, "history*.jsonl"))):
+        entries, problems = history_mod.read_entries(hpath)
+        hist[os.path.basename(hpath)] = {
+            "entries": len(entries), "problems": problems,
+            "last": entries[-1] if entries else None,
+        }
+    out["history"] = hist
+    return out, rc
+
+
+def report_main(target: str, as_json: bool = False,
+                critical_path: bool = False) -> int:
     """CLI body for ``tcr-consensus-tpu --report <workdir>``."""
     import sys
 
     nano = resolve_nano_dir(target)
     if nano is None:
-        print(f"--report: no run directory found at {target!r} (expected a "
-              "run-config JSON, a fastq_pass dir, or its nano_tcr subdir)",
-              file=sys.stderr)
+        msg = (f"--report: no run directory found at {target!r} (expected a "
+               "run-config JSON, a fastq_pass dir, or its nano_tcr subdir)")
+        print(msg, file=sys.stderr)
+        if as_json:
+            json.dump({"problems": [msg]}, sys.stdout)
+            sys.stdout.write("\n")
         return 2
-    text, rc = render_report(nano)
+    if as_json:
+        data, rc = collect_report(nano, critical_path=critical_path)
+        json.dump(data, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+        return rc
+    text, rc = render_report(nano, critical_path=critical_path)
     sys.stdout.write(text)
     return rc

@@ -65,6 +65,19 @@ def main(argv: list[str] | None = None) -> int:
         "it starts; --report explains a run AFTER it ran.",
     )
     parser.add_argument(
+        "--json", action="store_true",
+        help="With --report: emit the machine-readable report dump instead "
+        "of the human rendering (same artifact resolution rules and exit "
+        "codes).",
+    )
+    parser.add_argument(
+        "--critical-path", action="store_true",
+        help="With --report: analyze the executed stage graph recorded in "
+        "telemetry.json (joined with trace spans when present) — critical "
+        "path through the node DAG, per-node slack, what-if savings, "
+        "per-node dispatch tax, overlap-pool efficiency.",
+    )
+    parser.add_argument(
         "--validate", action="store_true",
         help="Dry-run input validation: parse the config, scan every input "
         "file (record counts/sizes via the tolerant parser — no device "
@@ -75,11 +88,17 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = parser.parse_args(argv)
 
+    if (args.json or args.critical_path) and not args.report:
+        parser.error("--json/--critical-path are --report options")
+
     if args.report:
         # never touches jax: safe on hosts with a wedged device tunnel
         from ont_tcrconsensus_tpu.obs import report as report_mod
 
-        return report_mod.report_main(args.json_config_file)
+        return report_mod.report_main(
+            args.json_config_file, as_json=args.json,
+            critical_path=args.critical_path,
+        )
 
     if args.validate:
         # never touches jax: safe on hosts with a wedged device tunnel

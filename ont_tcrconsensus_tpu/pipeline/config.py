@@ -160,6 +160,12 @@ class RunConfig:
     #   stage spans per thread + instant events for every robustness
     #   occurrence) and runs the periodic HBM/RSS sampler. Render with
     #   `tcr-consensus-tpu --report <workdir>`
+    history_ledger: str | None = None  # opt-in CROSS-run ledger path (e.g.
+    #   a repo-level BENCH_HISTORY.jsonl): every telemetry-armed run
+    #   appends its history entry there in addition to the per-run
+    #   nano_tcr/history.jsonl (obs/history.py) — the baseline pool
+    #   scripts/perf_gate.py gates new runs against. Excluded from the
+    #   config fingerprint (it is a location, not a workload knob)
     error_profile_sample: int = 512  # reads/library profiled for the cs-tag
     #   error artifact (qc/error_profile.py); 0 disables. 512 resolves any
     #   motif above ~1% of reads in the top-40 dump; raise for deeper audits
@@ -361,6 +367,13 @@ class RunConfig:
         if self.telemetry not in ("off", "on", "full"):
             raise ValueError(
                 f"telemetry={self.telemetry!r} not in ('off', 'on', 'full')"
+            )
+        if self.history_ledger is not None and (
+            not isinstance(self.history_ledger, str) or not self.history_ledger
+        ):
+            raise ValueError(
+                f"history_ledger={self.history_ledger!r} must be a non-empty "
+                "path string or null"
             )
         for pat_name in ("umi_fwd", "umi_rev"):
             pat = getattr(self, pat_name)

@@ -36,7 +36,9 @@ row on the trace timeline.
 from __future__ import annotations
 
 import threading
+import time
 
+from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
 from ont_tcrconsensus_tpu.obs import trace
 from ont_tcrconsensus_tpu.robustness import faults, watchdog
 
@@ -45,10 +47,11 @@ class DeferredStage:
     """One background stage: compute on a worker, result at commit time."""
 
     def __init__(self, name: str, permits: threading.Semaphore,
-                 units: int = 0):
+                 units: int = 0, on_done=None):
         self.name = name
         self.units = units
         self._permits = permits
+        self._on_done = on_done
         self._done = threading.Event()
         self._result = None
         self._exc: BaseException | None = None
@@ -77,6 +80,8 @@ class DeferredStage:
             self._exc = exc
         finally:
             self.worker_seconds = sp.dur_s
+            if self._on_done is not None:
+                self._on_done(self.worker_seconds)
             self._done.set()
             self._permits.release()
 
@@ -116,6 +121,37 @@ class StageExecutor:
     def __init__(self, max_in_flight: int = 2):
         self._permits = threading.Semaphore(max_in_flight)
         self._pending: list[DeferredStage] = []
+        self._slots = max_in_flight
+        # pool efficiency accounting (telemetry's overlap busy/idle split):
+        # window = first submit .. last worker completion, busy = summed
+        # worker wall clocks, idle = window * slots - busy
+        self._stats_lock = threading.Lock()
+        self._t_first_submit: float | None = None
+        self._t_last_done: float | None = None
+        self._busy_s = 0.0
+        self._pool_recorded = False
+
+    def _note_done(self, worker_seconds: float) -> None:
+        with self._stats_lock:
+            self._busy_s += worker_seconds
+            self._t_last_done = time.monotonic()
+
+    def record_pool_metrics(self) -> None:
+        """Roll this pool's busy/idle split into the armed telemetry
+        registry (once; no-op when nothing was ever submitted). Call after
+        the pool has drained — run.py does so per library."""
+        with self._stats_lock:
+            if self._pool_recorded or self._t_first_submit is None:
+                return
+            self._pool_recorded = True
+            end = self._t_last_done or self._t_first_submit
+            window = max(end - self._t_first_submit, 0.0)
+            busy = self._busy_s
+        obs_metrics.pool_add(
+            "overlap.pool", busy_s=busy,
+            idle_s=max(window * self._slots - busy, 0.0),
+            window_s=window, slots=self._slots,
+        )
 
     def submit(self, name: str, fn, /, *args, units: int = 0,
                **kwargs) -> DeferredStage:
@@ -127,7 +163,11 @@ class StageExecutor:
         count so a big background pass is not falsely cancelled. Stages
         whose fn heartbeats internally can leave it 0 (base deadline)."""
         self._permits.acquire()
-        stage = DeferredStage(name, self._permits, units=units)
+        with self._stats_lock:
+            if self._t_first_submit is None:
+                self._t_first_submit = time.monotonic()
+        stage = DeferredStage(name, self._permits, units=units,
+                              on_done=self._note_done)
         stage._call = (fn, args, kwargs)
         threading.Thread(
             target=stage._run, args=(fn, args, kwargs),

@@ -39,6 +39,7 @@ from ont_tcrconsensus_tpu.graph import executor as graph_exec
 from ont_tcrconsensus_tpu.io import bucketing, fastx, layout
 from ont_tcrconsensus_tpu.io import validate as validate_mod
 from ont_tcrconsensus_tpu.obs import device as obs_device
+from ont_tcrconsensus_tpu.obs import history as obs_history
 from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
 from ont_tcrconsensus_tpu.obs import report as obs_report
 from ont_tcrconsensus_tpu.obs import trace as obs_trace
@@ -474,6 +475,14 @@ def _run_with_config_body(
                 )
             except OSError as exc:
                 _log(f"WARNING: could not write telemetry artifacts: {exc!r}")
+            # cross-run ledger entry (obs/history.py): the run's summary
+            # keyed by git sha / config fingerprint / backend, appended to
+            # nano_tcr/history.jsonl (+ cfg.history_ledger when set) so
+            # scripts/perf_gate.py has a baseline to gate against.
+            # Never fails the run it records.
+            obs_history.record_run(
+                nano_dir, cfg, suffix="" if n_proc == 1 else f"_p{proc_id}",
+            )
     if failed_libraries:
         with open(os.path.join(nano_dir, f"failed_libraries_p{proc_id}.log"), "w") as fh:
             for library, err in failed_libraries:
@@ -539,6 +548,11 @@ def _run_library(fastq, lay, cfg, panel, engine, engine_notrim,
             for name, exc in qc_exec.wait_all():
                 _log(f"WARNING: overlapped stage {name} also failed: {exc!r}")
         raise
+    finally:
+        # pool busy/idle split into telemetry (drained by now on every
+        # path: commits on success, wait_all above on failure)
+        if qc_exec is not None:
+            qc_exec.record_pool_metrics()
 
 
 def _run_library_graph(fastq, lay, cfg, panel, engine, engine_notrim,

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Noise-aware perf regression gate over the run-history ledger.
+
+Compares one run (by default the ledger's newest entry) against the
+baseline pool of earlier entries that agree with it on config
+fingerprint, backend and n_reads (obs/history.py). The verdict is
+median + MAD based: a run regresses only when its metric is worse than
+the baseline median by more than
+
+    max(--threshold * median, --mad-k * 1.4826 * MAD)
+
+so a quiet baseline gates at the relative threshold while a noisy one
+widens to what its own scatter justifies. Fewer than ``--min-samples``
+matching baselines -> WARN and exit 0 (a thin ledger on a fresh machine
+records instead of failing; see README "Cross-run observability").
+
+Metric: ``reads_per_sec`` (higher is better; bench entries) when the
+current entry carries one, else ``duration_s`` (lower is better; run
+entries).
+
+Usage:
+    python scripts/perf_gate.py LEDGER.jsonl [--current latest|entry.json]
+        [--threshold 0.15] [--mad-k 4.0] [--min-samples 3] [--json]
+
+Exit codes: 0 pass/warn, 1 regression, 2 usage / unreadable ledger.
+Garbage ledger lines are skipped with a named stderr warning (never a
+traceback) — the gate must stay usable on the artifact someone tore.
+Never imports jax. Wired into scripts/tier1.sh as a smoke stage and
+callable from ``bench.py --gate``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ont_tcrconsensus_tpu.obs import history  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Gate a run's perf against the run-history ledger "
+        "(median + MAD over matching fingerprint/backend/n_reads entries)."
+    )
+    ap.add_argument("ledger", help="history .jsonl ledger path")
+    ap.add_argument(
+        "--current", default="latest",
+        help="'latest' (default: the ledger's newest entry, gated against "
+        "the rest) or a path to a JSON file holding one entry",
+    )
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="relative regression threshold vs the baseline "
+                    "median (default 0.15 = 15%%)")
+    ap.add_argument("--mad-k", type=float, default=4.0,
+                    help="noise widening: allowance is at least this many "
+                    "scaled MADs (default 4.0)")
+    ap.add_argument("--min-samples", type=int, default=3,
+                    help="matching baseline entries required to gate; "
+                    "fewer -> WARN, exit 0 (default 3)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the verdict as one JSON line")
+    args = ap.parse_args(argv)
+
+    entries, problems = history.read_entries(args.ledger)
+    for p in problems:
+        print(f"perf_gate: ledger {p}", file=sys.stderr)
+    if not entries:
+        print(f"perf_gate: no readable entries in {args.ledger}",
+              file=sys.stderr)
+        return 2
+    if args.current == "latest":
+        current = entries[-1]
+    else:
+        try:
+            with open(args.current) as fh:
+                current = json.load(fh)
+        except (OSError, ValueError) as exc:
+            print(f"perf_gate: unreadable --current {args.current!r}: "
+                  f"{exc!r}", file=sys.stderr)
+            return 2
+        if not isinstance(current, dict):
+            print(f"perf_gate: --current {args.current!r} is not a JSON "
+                  "object", file=sys.stderr)
+            return 2
+
+    result = history.evaluate_gate(
+        entries, current, rel_threshold=args.threshold,
+        mad_k=args.mad_k, min_samples=args.min_samples,
+    )
+    if args.json:
+        print(json.dumps(dataclasses.asdict(result), sort_keys=True))
+    else:
+        print(f"perf_gate: {result.status.upper()} — {result.reason}")
+    return 1 if result.status == "fail" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

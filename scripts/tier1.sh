@@ -17,7 +17,11 @@
 # telemetry=full — artifacts exist + validate, pipeline outputs
 # byte-identical to telemetry=off), a graph-executor smoke (tiny workload
 # under executor=graph vs imperative — counts CSV + consensus FASTA
-# byte-identical, telemetry attributed per node), the differential ingest fuzzer
+# byte-identical, telemetry attributed per node), a perf-gate smoke (two
+# tiny runs feed a shared run-history ledger; scripts/perf_gate.py stays
+# quiet on an identical replay and exits nonzero on a seeded +30%
+# regression; --report --critical-path explains the executed graph
+# consistently with wall time), the differential ingest fuzzer
 # standalone (5 seeds), and a seeded-corpus replay through the ASan/UBSan
 # parser build (scripts/fuzz_ingest.py --sanitized; the >=1000-corpus
 # campaigns are the slow-marked tests).
@@ -103,6 +107,19 @@ grc=$?
 if [ "$grc" -ne 0 ]; then
     echo "graph executor smoke FAILED (rc=$grc)" >&2
     exit "$grc"
+fi
+
+echo "--- perf-gate smoke (two tiny runs feed a shared history ledger:"
+echo "    scripts/perf_gate.py passes on an identical replay and fails on"
+echo "    a seeded +30% regression; --report --critical-path explains the"
+echo "    executed graph) ---"
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/test_history.py -q \
+    -k "perf_gate_passes_replay or perf_gate_cli or critical_path_matches" \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+prc=$?
+if [ "$prc" -ne 0 ]; then
+    echo "perf-gate smoke FAILED (rc=$prc)" >&2
+    exit "$prc"
 fi
 
 echo "--- ingest fuzz smoke (native vs Python differential, 5 seeds) ---"

@@ -1,0 +1,194 @@
+"""Unit tests for obs/critical_path.py on hand-built synthetic graphs.
+
+The analyzer must reconstruct the executed DAG from a telemetry.json
+payload alone: known slack/what-if answers on a diamond graph, the
+never-crash degradation ladder for garbage artifacts, the dispatch-tax
+join, pool efficiency, and the ``--report --critical-path`` /
+``--report --json`` surfaces over a synthetic artifact directory.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ont_tcrconsensus_tpu.obs import critical_path, report as obs_report
+
+
+def diamond_telemetry() -> dict:
+    """A -> (B, C) -> D with durations 2/3/1/1: the critical path is
+    A-B-D = 6s, C has 2s slack, and only A and B are worth attacking."""
+    return {
+        "telemetry": "on",
+        "duration_s": 6.5,
+        "graph": {
+            "nodes": {
+                "A": {"critical_s": 2.0, "overlapped_s": 0.0, "runs": 1,
+                      "skips": 0, "units": 10, "inputs": [],
+                      "outputs": ["a"]},
+                "B": {"critical_s": 3.0, "overlapped_s": 0.0, "runs": 1,
+                      "skips": 0, "units": 5, "inputs": ["a"],
+                      "outputs": ["b"]},
+                "C": {"critical_s": 1.0, "overlapped_s": 1.5, "runs": 1,
+                      "skips": 0, "units": 2, "inputs": ["a"],
+                      "outputs": ["c"]},
+                "D": {"critical_s": 1.0, "overlapped_s": 0.0, "runs": 1,
+                      "skips": 0, "units": 1, "inputs": ["b", "c"],
+                      "outputs": ["d"]},
+            },
+            "edges": {"a": "hbm", "b": "hbm", "c": "host", "d": "disk"},
+            "pool": {"busy_s": 3.0, "idle_s": 1.0, "window_s": 2.0,
+                     "slots": 2},
+        },
+        "dispatch_by_stage": {
+            "B": {"dispatches": 4, "gets": 2, "host_s": 0.5, "block_s": 1.2},
+            "C_bg": {"dispatches": 1, "gets": 1, "host_s": 0.1,
+                     "block_s": 0.2},
+        },
+    }
+
+
+def test_diamond_known_answers():
+    a = critical_path.analyze(diamond_telemetry())
+    assert a["problems"] == []
+    assert a["critical_path"] == ["A", "B", "D"]
+    assert a["critical_path_s"] == 6.0
+    assert a["nodes_total_s"] == 7.0
+    nodes = a["nodes"]
+    assert nodes["C"]["slack_s"] == 2.0
+    assert nodes["A"]["slack_s"] == 0.0 and nodes["B"]["slack_s"] == 0.0
+    assert nodes["C"]["on_critical_path"] is False
+    assert nodes["B"]["on_critical_path"] is True
+    # what-if: freeing B shortens to A-C-D = 4s (saves 2); freeing C
+    # saves nothing — it was never on the path
+    assert nodes["B"]["what_if_saved_s"] == 2.0
+    assert nodes["C"]["what_if_saved_s"] == 0.0
+    assert nodes["A"]["what_if_saved_s"] == 2.0  # B(3)+D(1)=4 remains
+    assert nodes["B"]["units"] == 5
+
+
+def test_dispatch_tax_join_folds_bg_spans():
+    a = critical_path.analyze(diamond_telemetry())
+    assert a["nodes"]["B"]["dispatch"] == {
+        "dispatches": 4, "gets": 2, "host_s": 0.5, "block_s": 1.2}
+    # the worker's C_bg span rolls into node C
+    assert a["nodes"]["C"]["dispatch"]["block_s"] == 0.2
+    assert a["nodes"]["A"]["dispatch"] is None
+
+
+def test_pool_efficiency():
+    a = critical_path.analyze(diamond_telemetry())
+    assert a["pool"]["busy_s"] == 3.0
+    assert a["pool"]["efficiency"] == 0.75
+    # imperative-path artifact: pool rides top-level, no graph section
+    b = critical_path.analyze({"overlap_pool": {"busy_s": 1.0,
+                                                "idle_s": 3.0}})
+    assert b["problems"]  # no graph -> named problem, but never a crash
+
+
+def test_trace_join_computes_makespan():
+    trace = {"traceEvents": [
+        {"ph": "X", "name": "A", "ts": 0.0, "dur": 2e6},
+        {"ph": "X", "name": "B", "ts": 2e6, "dur": 3e6},
+        {"ph": "X", "name": "C_bg", "ts": 2e6, "dur": 1e6},
+        {"ph": "X", "name": "D", "ts": 5e6, "dur": 1e6},
+        {"ph": "i", "name": "chaos.inject", "ts": 1.0},
+        {"ph": "X", "name": "unrelated", "ts": 0.0, "dur": 9e9},
+    ]}
+    a = critical_path.analyze(diamond_telemetry(), trace)
+    assert a["trace"]["makespan_s"] == 6.0
+    assert a["trace"]["node_windows_s"]["C"] == [2.0, 3.0]
+
+
+def test_degrades_to_named_problems():
+    # no graph section at all (imperative / pre-graph artifact)
+    a = critical_path.analyze({"duration_s": 1.0})
+    assert any("no executed-graph section" in p for p in a["problems"])
+    assert "critical_path" not in a
+    # graph present but nodes is garbage
+    a = critical_path.analyze({"graph": {"nodes": "what"}})
+    assert any("no nodes object" in p for p in a["problems"])
+    # one garbage node entry is dropped by name; the rest still analyze
+    tele = diamond_telemetry()
+    tele["graph"]["nodes"]["Z"] = ["not", "an", "object"]
+    tele["graph"]["nodes"]["B"]["critical_s"] = "fast"
+    a = critical_path.analyze(tele)
+    assert any("'Z'" in p for p in a["problems"])
+    assert any("bad critical_s" in p for p in a["problems"])
+    assert a["critical_path"]  # still computed (B treated as 0s)
+    # dependency metadata absent -> named problem, totals still reported
+    bare = {"graph": {"nodes": {"A": {"critical_s": 2.0}}}}
+    a = critical_path.analyze(bare)
+    assert any("no inputs/outputs metadata" in p for p in a["problems"])
+    assert a["nodes_total_s"] == 2.0 and "critical_path" not in a
+    # a dependency cycle cannot crash the walk
+    cyc = {"graph": {"nodes": {
+        "A": {"critical_s": 1.0, "inputs": ["b"], "outputs": ["a"]},
+        "B": {"critical_s": 1.0, "inputs": ["a"], "outputs": ["b"]},
+    }}}
+    a = critical_path.analyze(cyc)
+    assert any("cycle" in p for p in a["problems"])
+    # not even a dict
+    a = critical_path.analyze([])
+    assert a["problems"]
+
+
+def test_render_smoke():
+    lines: list[str] = []
+    critical_path.render(critical_path.analyze(diamond_telemetry()), lines)
+    text = "\n".join(lines)
+    assert "critical path: 6.000s over 3 node(s)" in text
+    assert "what-if" in text and "overlap pool" in text
+    # problem-only analyses render their problems and stop
+    lines = []
+    critical_path.render(critical_path.analyze({}), lines)
+    assert lines and "critical-path:" in lines[0]
+
+
+# --- the --report surfaces over a synthetic artifact dir ---------------------
+
+
+def _write_artifact(tmp_path, payload) -> str:
+    wd = tmp_path / "nano_tcr"
+    wd.mkdir(exist_ok=True)
+    (wd / "telemetry.json").write_text(json.dumps(payload))
+    return str(wd)
+
+
+def test_report_critical_path_text(tmp_path, capsys):
+    wd = _write_artifact(tmp_path, diamond_telemetry())
+    assert obs_report.report_main(wd, critical_path=True) == 0
+    out = capsys.readouterr().out
+    assert "critical path: 6.000s" in out
+    assert "overlap pool: busy 3.000s" in out
+
+
+def test_report_json_machine_dump(tmp_path, capsys):
+    wd = _write_artifact(tmp_path, diamond_telemetry())
+    assert obs_report.report_main(wd, as_json=True, critical_path=True) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["problems"] == []
+    assert data["telemetry"]["telemetry.json"]["duration_s"] == 6.5
+    cp = data["critical_path"]["telemetry.json"]
+    assert cp["critical_path"] == ["A", "B", "D"]
+    assert data["history"] == {} and data["stage_timing_tsvs"] == 0
+
+
+def test_report_json_never_crash_matches_text_exit_codes(tmp_path, capsys):
+    """--json holds the same never-crash contract and exit codes as the
+    text renderer on valid-JSON-but-garbage artifacts."""
+    wd = tmp_path / "nano_tcr"
+    wd.mkdir()
+    (wd / "telemetry.json").write_text('{"stages": [], "dispatch": 7}')
+    (wd / "telemetry_p1.json").write_text('["not", "an", "object"]')
+    assert obs_report.report_main(str(wd), as_json=True,
+                                  critical_path=True) == 1
+    data = json.loads(capsys.readouterr().out)
+    probs = "\n".join(data["problems"])
+    assert "malformed telemetry artifact telemetry.json" in probs
+    assert "telemetry_p1.json: not a JSON object" in probs
+    # empty dir -> same "no telemetry" exit 1; nonsense target -> exit 2
+    empty = tmp_path / "empty" / "nano_tcr"
+    empty.mkdir(parents=True)
+    assert obs_report.report_main(str(empty), as_json=True) == 1
+    capsys.readouterr()
+    assert obs_report.report_main(str(tmp_path / "nope"), as_json=True) == 2

@@ -341,8 +341,12 @@ def test_executor_resume_skips_closure_and_reloads_crossing_edges():
     assert ran == [N_TAIL]
     nodes = reg.summary()["graph"]["nodes"]
     for skipped in (N_LOAD, N_QC, N_RESUME):
-        assert nodes[skipped] == {"critical_s": 0.0, "overlapped_s": 0.0,
-                                  "runs": 0, "skips": 1}
+        entry = nodes[skipped]
+        assert entry["critical_s"] == 0.0 and entry["overlapped_s"] == 0.0
+        assert entry["runs"] == 0 and entry["skips"] == 1
+        # declared structure is recorded even for skipped nodes, so the
+        # critical-path analyzer sees the full DAG on resume artifacts
+        assert "inputs" in entry and "outputs" in entry
     assert nodes[N_TAIL]["runs"] == 1
 
 
@@ -676,7 +680,12 @@ def test_graph_chaos_corrupt_counts_resumes_from_round1_node(
     for skipped in ("round1_fused_assign", "round1_polish",
                     "round1_error_profile", "write_region_fastas",
                     "round1_consensus"):
-        assert g[skipped] == {"critical_s": 0.0, "overlapped_s": 0.0,
-                              "runs": 0, "skips": 1}, skipped
+        entry = g[skipped]
+        assert entry["critical_s"] == 0.0 and entry["overlapped_s"] == 0.0
+        assert entry["runs"] == 0 and entry["skips"] == 1, skipped
+        # declared edges survive the skip (the critical-path analyzer
+        # rebuilds the DAG from resume artifacts too); units stay 0 —
+        # nothing was evaluated
+        assert "inputs" in entry and entry["units"] == 0, skipped
     for ran in ("round2_fused_assign", "round2_counts"):
         assert g[ran]["runs"] == 1 and g[ran]["skips"] == 0, ran

@@ -1,0 +1,449 @@
+"""Cross-run history ledger + noise-aware perf gate (obs/history.py,
+scripts/perf_gate.py).
+
+Unit half: config fingerprint stability, ledger append/rotation,
+garbage-line degradation, and the median+MAD gate math on synthetic
+ledgers with known answers (identical replay stays quiet, a seeded +30%
+regression fails, a thin ledger warns, a noisy baseline self-widens).
+
+E2e half (also the tier-1 perf-gate smoke via scripts/tier1.sh): two tiny
+pipeline runs share a cross-run ledger, the gate passes on replay and
+fails on a seeded +30% regression, ``--report --critical-path`` explains
+the executed graph consistently with the measured wall time, and the
+ledger knob leaves the pipeline outputs byte-identical.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from ont_tcrconsensus_tpu.obs import history
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+PERF_GATE = REPO_ROOT / "scripts" / "perf_gate.py"
+
+
+def _entry(fp="abc", backend="cpu", n_reads=100, **kw) -> dict:
+    e = {"schema": 1, "fingerprint": fp, "backend": backend,
+         "n_reads": n_reads}
+    e.update(kw)
+    return e
+
+
+# ---------------------------------------------------------------------------
+# config fingerprint
+
+
+def test_fingerprint_ignores_paths_but_sees_knobs():
+    from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+
+    a = RunConfig.from_dict({"reference_file": "r.fa",
+                             "fastq_pass_dir": "fq"})
+    b = RunConfig.from_dict({
+        "reference_file": "/elsewhere/other.fa",
+        "fastq_pass_dir": "/mnt/run42/fastq_pass",
+        "history_ledger": "/tmp/BENCH_HISTORY.jsonl",
+    })
+    # same workload from another directory/machine -> same baseline pool
+    assert history.config_fingerprint(a) == history.config_fingerprint(b)
+    c = RunConfig.from_dict({"reference_file": "r.fa",
+                             "fastq_pass_dir": "fq",
+                             "read_batch_size": 32})
+    assert history.config_fingerprint(c) != history.config_fingerprint(a)
+    assert len(history.config_fingerprint(a)) == 16
+
+
+def test_fingerprint_is_key_order_insensitive_on_dicts():
+    assert (history.config_fingerprint({"a": 2, "b": 1})
+            == history.config_fingerprint({"b": 1, "a": 2}))
+    assert (history.config_fingerprint({"a": 2, "reference_file": "x"})
+            == history.config_fingerprint({"a": 2, "reference_file": "y"}))
+
+
+def test_git_sha_and_backend_detection_never_raise(tmp_path):
+    sha = history.git_sha()  # the package lives in a repo here
+    assert sha is None or (len(sha) == 40 and sha == sha.strip())
+    assert history.git_sha(cwd=str(tmp_path)) is None  # not a repo
+    assert history.detect_backend() in (None, "cpu", "tpu", "gpu")
+
+
+# ---------------------------------------------------------------------------
+# ledger file discipline
+
+
+def test_append_rotates_to_newest_entries(tmp_path):
+    path = str(tmp_path / "h.jsonl")
+    for i in range(7):
+        history.append_entry(path, _entry(i=i), max_entries=3)
+    entries, problems = history.read_entries(path)
+    assert problems == []
+    assert [e["i"] for e in entries] == [4, 5, 6]
+
+
+def test_read_entries_degrades_garbage_lines(tmp_path):
+    path = tmp_path / "h.jsonl"
+    path.write_text(
+        json.dumps(_entry(i=0)) + "\n"
+        + "{torn half of an entr\n"
+        + "[1, 2, 3]\n"
+        + "\n"
+        + json.dumps(_entry(i=1)) + "\n"
+    )
+    entries, problems = history.read_entries(str(path))
+    assert [e["i"] for e in entries] == [0, 1]
+    assert any(p.startswith("line 2: not valid JSON") for p in problems)
+    assert any(p.startswith("line 3: not a JSON object") for p in problems)
+    entries, problems = history.read_entries(str(tmp_path / "missing.jsonl"))
+    assert entries == [] and "unreadable ledger" in problems[0]
+
+
+def test_build_entry_rolls_up_telemetry_summary():
+    tele = {
+        "duration_s": 5.0,
+        "stages": {"round1_polish": {"seconds": 1.5, "calls": 2},
+                   "junk": "not a dict"},
+        "dispatch": {"polish.dispatch": {"host_s": 0.1, "block_s": 0.2},
+                     "assign.dispatch": {"host_s": 0.3, "block_s": 0.4}},
+        "compile": {"count": 3, "seconds": 2.0},
+        "gauges": {"device.hbm_bytes_in_use": 100, "host.rss_bytes": 200},
+    }
+    e = history.build_entry("run", tele, fingerprint="f", sha="s",
+                            backend="cpu", extra={"note": 1})
+    assert e["schema"] == history.SCHEMA_VERSION
+    assert e["source"] == "run" and e["fingerprint"] == "f"
+    assert e["duration_s"] == 5.0
+    assert e["stages"] == {"round1_polish": 1.5}
+    assert e["dispatch_host_s"] == 0.4 and e["dispatch_block_s"] == 0.6
+    assert e["compile_count"] == 3 and e["compile_s"] == 2.0
+    assert e["hbm_high_water_bytes"] == 100 and e["note"] == 1
+    bare = history.build_entry("bench", None, reads_per_sec=12.5)
+    assert bare["reads_per_sec"] == 12.5 and "duration_s" not in bare
+
+
+# ---------------------------------------------------------------------------
+# gate math on synthetic ledgers
+
+
+def test_gate_quiet_on_identical_replay():
+    entries = [_entry(duration_s=10.0) for _ in range(5)]
+    res = history.evaluate_gate(entries, _entry(duration_s=10.0))
+    assert res.status == "pass" and res.n_baseline == 5
+    assert res.baseline_median == 10.0 and res.baseline_mad == 0.0
+
+
+def test_gate_fails_seeded_30pct_regression_on_quiet_baseline():
+    entries = [_entry(duration_s=10.0) for _ in range(5)]
+    res = history.evaluate_gate(entries, _entry(duration_s=13.0))
+    assert res.status == "fail" and "regression" in res.reason
+    assert res.allowance == pytest.approx(1.5)  # 15% of the median
+    # throughput metric gates in the opposite direction
+    entries = [_entry(reads_per_sec=100.0) for _ in range(5)]
+    assert history.evaluate_gate(
+        entries, _entry(reads_per_sec=70.0)).status == "fail"
+    assert history.evaluate_gate(
+        entries, _entry(reads_per_sec=90.0)).status == "pass"
+    # improvements never fail
+    assert history.evaluate_gate(
+        entries, _entry(reads_per_sec=500.0)).status == "pass"
+
+
+def test_gate_noisy_baseline_widens_its_own_allowance():
+    durs = [10.0, 12.0, 8.0, 14.0, 6.0]  # median 10, MAD 2
+    entries = [_entry(duration_s=d) for d in durs]
+    res = history.evaluate_gate(entries, _entry(duration_s=13.0))
+    assert res.status == "pass"  # 4 * 1.4826 * 2 = 11.86s allowance
+    assert res.allowance == pytest.approx(4 * history.MAD_SCALE * 2.0)
+    # the same +30% WOULD fail were the baseline quiet (previous test);
+    # with mad_k=0 the noisy baseline gates at the bare threshold again
+    res = history.evaluate_gate(entries, _entry(duration_s=13.0), mad_k=0.0)
+    assert res.status == "fail"
+
+
+def test_gate_warns_on_thin_ledger_and_missing_metric():
+    entries = [_entry(duration_s=10.0) for _ in range(2)]
+    res = history.evaluate_gate(entries, _entry(duration_s=99.0))
+    assert res.status == "warn" and "thin ledger" in res.reason
+    res = history.evaluate_gate([], _entry())  # no metric at all
+    assert res.status == "warn" and "no usable metric" in res.reason
+    # bools are not metrics
+    assert history.evaluate_gate(
+        [], _entry(duration_s=True)).status == "warn"
+
+
+def test_gate_baseline_pool_filters_on_fingerprint_backend_n_reads():
+    entries = (
+        [_entry(fp="other", duration_s=1.0)] * 5
+        + [_entry(backend="tpu", duration_s=1.0)] * 5
+        + [_entry(n_reads=7, duration_s=1.0)] * 5
+        + [_entry(duration_s=10.0)] * 3
+    )
+    res = history.evaluate_gate(entries, _entry(duration_s=10.0))
+    assert res.status == "pass" and res.n_baseline == 3
+    assert res.baseline_median == 10.0  # the 1.0s foreigners never entered
+    # gating the ledger's own latest entry: identity exclusion, so an
+    # identical twin read from disk still counts as baseline
+    tail = _entry(duration_s=10.0)
+    pool = history.matching_entries(entries + [tail], tail)
+    assert len(pool) == 3 and all(e is not tail for e in pool)
+
+
+def test_gate_prefers_reads_per_sec_over_duration():
+    entries = [_entry(reads_per_sec=100.0, duration_s=10.0)
+               for _ in range(5)]
+    # duration regressed but throughput held: bench entries gate on rps
+    res = history.evaluate_gate(
+        entries, _entry(reads_per_sec=100.0, duration_s=50.0))
+    assert res.status == "pass" and res.metric == "reads_per_sec"
+
+
+# ---------------------------------------------------------------------------
+# perf_gate CLI (subprocess — the exact surface tier1.sh calls)
+
+
+def _gate(*args) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, str(PERF_GATE), *map(str, args)],
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+@pytest.fixture
+def quiet_ledger(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    for _ in range(4):
+        history.append_entry(path, _entry(duration_s=10.0))
+    return path
+
+
+def test_perf_gate_cli_pass_fail_and_json(tmp_path, quiet_ledger):
+    proc = _gate(quiet_ledger)  # latest vs the other three: identical
+    assert proc.returncode == 0 and "PASS" in proc.stdout, proc.stderr
+    # seeded +30% regression appended as the newest entry
+    history.append_entry(quiet_ledger, _entry(duration_s=13.0))
+    proc = _gate(quiet_ledger)
+    assert proc.returncode == 1
+    assert "FAIL" in proc.stdout and "regression" in proc.stdout
+    proc = _gate(quiet_ledger, "--json")
+    verdict = json.loads(proc.stdout)
+    assert verdict["status"] == "fail" and verdict["n_baseline"] == 4
+    # --current as an explicit entry file beats 'latest'
+    cur = tmp_path / "current.json"
+    cur.write_text(json.dumps(_entry(duration_s=10.1)))
+    proc = _gate(quiet_ledger, "--current", str(cur))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_perf_gate_cli_warn_and_usage_paths(tmp_path, quiet_ledger):
+    proc = _gate(quiet_ledger, "--min-samples", "99")
+    assert proc.returncode == 0 and "WARN" in proc.stdout
+    proc = _gate(tmp_path / "missing.jsonl")
+    assert proc.returncode == 2
+    proc = _gate(quiet_ledger, "--current", tmp_path / "nope.json")
+    assert proc.returncode == 2
+    # garbage ledger lines: named stderr warning, verdict still rendered
+    with open(quiet_ledger, "a") as fh:
+        fh.write("{torn half of an entr\n")
+    proc = _gate(quiet_ledger)
+    assert proc.returncode == 0 and "PASS" in proc.stdout
+    assert "line 5: not valid JSON" in proc.stderr
+
+
+def test_perf_gate_runs_with_jax_poisoned(quiet_ledger):
+    """The gate (like --report) must work on a wedged-tunnel host where
+    any ``import jax`` hangs or raises."""
+    code = (
+        "import sys, runpy\n"
+        "sys.modules['jax'] = None\n"
+        f"sys.argv = ['perf_gate.py', {quiet_ledger!r}]\n"
+        f"runpy.run_path({str(PERF_GATE)!r}, run_name='__main__')\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "PASS" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# e2e: two tiny runs -> shared ledger -> gate; --report --critical-path
+
+
+@pytest.fixture(scope="module")
+def history_library(tmp_path_factory):
+    from ont_tcrconsensus_tpu.io import fastx, simulator
+
+    tmp = tmp_path_factory.mktemp("history_e2e")
+    lib = simulator.simulate_library(
+        seed=29,
+        num_regions=2,
+        molecules_per_region=(2, 2),
+        reads_per_molecule=(5, 6),
+        sub_rate=0.006,
+        ins_rate=0.003,
+        del_rate=0.003,
+        region_len=(650, 750),
+    )
+    fastx.write_fasta(tmp / "reference.fa", lib.reference.items())
+    fq_dir = tmp / "fastq_pass" / "barcode01"
+    fq_dir.mkdir(parents=True)
+    fastx.write_fastq(fq_dir / "barcode01.fastq.gz", lib.reads)
+    return tmp, lib
+
+
+def _run(src, root, ledger: str | None):
+    from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+    from ont_tcrconsensus_tpu.pipeline.run import run_with_config
+
+    root.mkdir(parents=True, exist_ok=True)
+    shutil.copy(src / "reference.fa", root / "reference.fa")
+    shutil.copytree(src / "fastq_pass", root / "fastq_pass")
+    cfg = RunConfig.from_dict({
+        "reference_file": str(root / "reference.fa"),
+        "fastq_pass_dir": str(root / "fastq_pass"),
+        "minimal_length": 600,
+        "min_reads_per_cluster": 4,
+        "read_batch_size": 64,
+        "polish_method": "poa",
+        "delete_tmp_files": False,
+        "telemetry": "on",
+        **({"history_ledger": ledger} if ledger else {}),
+    })
+    return run_with_config(cfg), root / "fastq_pass" / "nano_tcr"
+
+
+@pytest.fixture(scope="module")
+def ledger_runs(history_library, tmp_path_factory):
+    src, lib = history_library
+    ledger = str(tmp_path_factory.mktemp("ledger") / "BENCH_HISTORY.jsonl")
+    res1, nano1 = _run(src, tmp_path_factory.mktemp("h_run1"), ledger)
+    # the second run takes NO ledger knob (pins both the byte-identity
+    # acceptance and history_ledger's exclusion from the fingerprint);
+    # its per-run entry is appended by hand, as an operator would
+    res2, nano2 = _run(src, tmp_path_factory.mktemp("h_run2"), None)
+    entries2, problems2 = history.read_entries(str(nano2 / "history.jsonl"))
+    assert problems2 == [] and len(entries2) == 1
+    history.append_entry(ledger, entries2[0])
+    return lib, res1, nano1, res2, nano2, ledger
+
+
+def test_run_writes_history_entry(ledger_runs):
+    lib, res1, nano1, _, _, ledger = ledger_runs
+    assert res1["barcode01"] == lib.true_counts
+    entries, problems = history.read_entries(str(nano1 / "history.jsonl"))
+    assert problems == [] and len(entries) == 1
+    e = entries[0]
+    assert e["source"] == "run" and e["schema"] == history.SCHEMA_VERSION
+    assert e["backend"] == "cpu"
+    assert e["duration_s"] > 0 and e["stages"]
+    assert isinstance(e["fingerprint"], str) and len(e["fingerprint"]) == 16
+    # recorded entries survive the renderer: --report names the ledger
+    from ont_tcrconsensus_tpu.obs import report as obs_report
+
+    text, rc = obs_report.render_report(str(nano1))
+    assert rc == 0 and "run history: 1 entrie(s) in history.jsonl" in text
+
+
+def test_shared_ledger_pools_runs_by_fingerprint(ledger_runs):
+    *_, ledger = ledger_runs
+    entries, problems = history.read_entries(ledger)
+    assert problems == [] and len(entries) == 2
+    # different directories, one with the ledger knob set: same pool
+    assert entries[0]["fingerprint"] == entries[1]["fingerprint"]
+    assert entries[0]["backend"] == entries[1]["backend"] == "cpu"
+
+
+def test_ledger_knob_keeps_outputs_byte_identical(ledger_runs):
+    lib, res1, nano1, res2, nano2, _ = ledger_runs
+    assert res1 == res2 == {"barcode01": lib.true_counts}
+    for rel in (
+        ("barcode01", "counts", "umi_consensus_counts.csv"),
+        ("barcode01", "fasta", "merged_consensus.fasta"),
+    ):
+        assert (nano1.joinpath(*rel).read_bytes()
+                == nano2.joinpath(*rel).read_bytes()), rel
+
+
+def test_perf_gate_passes_replay_and_fails_seeded_regression(
+        ledger_runs, tmp_path):
+    """The tier-1 smoke contract: a real two-run ledger gates quiet on an
+    identical replay and loud on a +30% synthetic regression (mad_k=0
+    keeps the two-sample allowance at the bare 15% threshold)."""
+    *_, ledger = ledger_runs
+    entries, _ = history.read_entries(ledger)
+    replay = dict(entries[-1])  # byte-for-byte rerun of the newest run
+    good = str(tmp_path / "replay.jsonl")
+    shutil.copy(ledger, good)
+    history.append_entry(good, replay)
+    proc = _gate(good, "--min-samples", "2", "--mad-k", "0")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout
+    seeded = dict(entries[-1])
+    durs = sorted(e["duration_s"] for e in entries)
+    med = 0.5 * (durs[0] + durs[1])
+    seeded["duration_s"] = round(1.3 * med, 3)  # the seeded +30% regression
+    bad = str(tmp_path / "regressed.jsonl")
+    shutil.copy(ledger, bad)
+    history.append_entry(bad, seeded)
+    proc = _gate(bad, "--min-samples", "2", "--mad-k", "0")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "FAIL" in proc.stdout and "regression" in proc.stdout
+
+
+def test_report_critical_path_matches_wall_time(ledger_runs, capsys):
+    from ont_tcrconsensus_tpu.obs import report as obs_report
+
+    _, _, nano1, *_ = ledger_runs
+    assert obs_report.report_main(str(nano1), as_json=True,
+                                  critical_path=True) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["problems"] == []
+    tele = data["telemetry"]["telemetry.json"]
+    cp = data["critical_path"]["telemetry.json"]
+    assert cp["problems"] == []
+    assert cp["critical_path"], "executed graph must yield a critical path"
+    # the critical path is bounded by (and explains most of) the per-
+    # library wall time: above the node sum's floor, never above duration
+    assert 0 < cp["critical_path_s"] <= cp["nodes_total_s"]
+    assert cp["critical_path_s"] <= tele["duration_s"] * 1.05 + 0.5
+    nodes = cp["nodes"]
+    assert any(n["on_critical_path"] for n in nodes.values())
+    for info in nodes.values():
+        assert info["slack_s"] >= 0.0 and info["what_if_saved_s"] >= 0.0
+    # units flowed from the executor's declarations into the artifact
+    assert any(isinstance(n.get("units"), int) and n["units"] > 0
+               for n in nodes.values())
+    # pool accounting (busy/idle split) landed under graph.pool
+    pool = tele["graph"].get("pool")
+    assert pool and pool["slots"] >= 1 and pool["busy_s"] >= 0.0
+    # human mode renders the same analysis, exit 0
+    assert obs_report.report_main(str(nano1), critical_path=True) == 0
+    out = capsys.readouterr().out
+    assert "critical path:" in out and "what-if" in out
+
+
+def test_run_history_never_fails_the_run(tmp_path, capsys):
+    """record_run's never-crash contract: no armed registry -> silent
+    no-op; an unwritable target degrades to a stderr warning, never an
+    exception on the run's roll-up path."""
+    from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
+
+    assert history.record_run(str(tmp_path), {}) is None  # disarmed
+    obs_metrics.arm()
+    try:
+        blocker = tmp_path / "not_a_dir"
+        blocker.write_text("x")  # nano_dir is a file: every write fails
+        assert history.record_run(str(blocker), {}) is None
+        # armed + writable: the entry lands and is returned
+        entry = history.record_run(str(tmp_path), {})
+        assert entry is not None and entry["source"] == "run"
+        on_disk, problems = history.read_entries(
+            str(tmp_path / "history.jsonl"))
+        assert problems == [] and len(on_disk) == 1
+    finally:
+        obs_metrics.disarm()
+    assert "could not append run-history entry" in capsys.readouterr().err

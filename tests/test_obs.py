@@ -329,6 +329,21 @@ def test_telemetry_full_e2e_artifacts(telemetry_runs):
     for line in lines[1:]:
         stage, sec, calls = line.split("\t")
         assert sec == f"{float(sec):.3f}" and int(calls) >= 1
+    # cross-run observability (obs/history.py): every telemetry-armed run
+    # appends one entry to nano_tcr/history.jsonl
+    from ont_tcrconsensus_tpu.obs import history as obs_history
+
+    entries, problems = obs_history.read_entries(str(nano / "history.jsonl"))
+    assert problems == [] and len(entries) == 1
+    assert entries[0]["source"] == "run" and entries[0]["backend"] == "cpu"
+    # graph nodes carry declared edges + units; the worker pool's
+    # busy/idle split lands under graph.pool (graph executor default)
+    gnodes = tele["graph"]["nodes"]
+    assert any(g.get("inputs") or g.get("outputs") for g in gnodes.values())
+    assert any(g.get("units") for g in gnodes.values())
+    pool = tele["graph"]["pool"]
+    assert pool["slots"] >= 1 and pool["busy_s"] >= 0.0
+    assert pool["idle_s"] >= 0.0 and pool["window_s"] >= 0.0
 
 
 def test_telemetry_off_is_byte_identical_and_artifact_free(telemetry_runs):
@@ -336,6 +351,7 @@ def test_telemetry_off_is_byte_identical_and_artifact_free(telemetry_runs):
     assert res_off == res_full == {"barcode01": lib.true_counts}
     assert not (nano_off / "telemetry.json").exists()
     assert not (nano_off / "logs" / "trace.json").exists()
+    assert not (nano_off / "history.jsonl").exists()
     for rel in (
         ("barcode01", "counts", "umi_consensus_counts.csv"),
         ("barcode01", "fasta", "merged_consensus.fasta"),

@@ -37,14 +37,17 @@ from tools.graftlint.core import FileCtx, Finding, Project
 
 RULES = {
     "obs-unknown-site": "telemetry site literal (counter_add/gauge_max/"
-                        "observe/span/instant/dispatch/timed_get/stage) "
-                        "not in obs.KNOWN_SITES (dead metric/span name)",
+                        "observe/pool_add/span/instant/dispatch/timed_get/"
+                        "stage) not in obs.KNOWN_SITES (dead metric/span "
+                        "name)",
     "obs-unplanted-site": "obs.KNOWN_SITES entry not planted at any "
                           "telemetry call site in the scanned tree",
 }
 
 _PLANT_FUNCS = {
     "counter_add", "gauge_max", "observe",  # obs.metrics
+    "pool_add",                             # obs.metrics (worker-pool
+    # busy/idle split, planted by pipeline.overlap.StageExecutor)
     "span", "instant",                      # obs.trace
     "dispatch", "timed_get",                # obs.device
     "stage",                                # qc.timing.StageTimer.stage
