@@ -40,10 +40,19 @@ PLACEMENTS = ("hbm", "host", "disk")
 
 @dataclasses.dataclass(frozen=True)
 class Edge:
-    """A named, placement-typed artifact flowing between nodes."""
+    """A named, placement-typed artifact flowing between nodes.
+
+    ``sharding`` is an optional device-layout spec name (ROADMAP item 2
+    groundwork): a label like ``"data"`` naming how an ``hbm`` value is
+    laid out across the mesh.  The executor ignores it for now; graftcheck
+    pairs producer-side and consumer-side specs and reports any node whose
+    hbm inputs and outputs disagree as a reshard site.  Only ``hbm`` edges
+    may carry one — host/disk values have no device layout.
+    """
 
     name: str
     placement: str
+    sharding: str | None = None
 
 
 @dataclasses.dataclass
@@ -168,6 +177,8 @@ class GraphSpec:
             "name": self.name,
             "nodes": [n.name for n in self.schedule],
             "edges": {e.name: e.placement for e in self.edges.values()},
+            "shardings": {e.name: e.sharding for e in self.edges.values()
+                          if e.sharding is not None},
             "side_sinks": self.side_sinks(),
             "results": list(self.results),
         }
@@ -185,7 +196,8 @@ class GraphBuilder:
         self._results: list[str] = []
         self._problems: list[str] = []
 
-    def edge(self, name: str, placement: str) -> None:
+    def edge(self, name: str, placement: str,
+             sharding: str | None = None) -> None:
         if name in self._edges:
             self._problems.append(f"edge {name!r} declared twice")
             return
@@ -194,7 +206,21 @@ class GraphBuilder:
                 f"edge {name!r}: unknown placement {placement!r} "
                 f"(expected one of {'|'.join(PLACEMENTS)})"
             )
-        self._edges[name] = Edge(name, placement)
+        if sharding is not None:
+            if not isinstance(sharding, str) or not sharding:
+                self._problems.append(
+                    f"edge {name!r}: sharding spec must be a non-empty "
+                    f"string, got {sharding!r}"
+                )
+                sharding = None
+            elif placement != "hbm":
+                self._problems.append(
+                    f"edge {name!r}: sharding {sharding!r} declared on a "
+                    f"{placement!r} edge (only hbm values have a device "
+                    "layout)"
+                )
+                sharding = None
+        self._edges[name] = Edge(name, placement, sharding)
 
     def input(self, name: str, placement: str = "disk") -> None:
         self.edge(name, placement)
@@ -230,6 +256,14 @@ class GraphBuilder:
         problems = list(self._problems)
         producer: dict[str, str] = {}
         consumed: dict[str, list[str]] = {}
+        node_names = {n.name for n in self._nodes}
+        for e in self._edges:
+            if e in node_names:
+                problems.append(
+                    f"edge {e!r} collides with a node of the same name — "
+                    "schedules, telemetry and resume keys could not tell "
+                    "them apart"
+                )
         for n in self._nodes:
             for e in n.inputs:
                 if e not in self._edges:

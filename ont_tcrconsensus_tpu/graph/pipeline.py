@@ -54,7 +54,11 @@ class LibraryContext:
 def build_library_graph(cfg: RunConfig) -> GraphSpec:
     b = GraphBuilder("library")
     b.input("library_fastq", "disk")
-    b.edge("read_store", "hbm")
+    # Both device stores are batch-sharded over the mesh's data axis
+    # (ROADMAP item 2 groundwork): the spec is declarative for now — the
+    # executor ignores it, graftcheck pairs producer/consumer specs and
+    # would flag any node whose hbm inputs and outputs disagree.
+    b.edge("read_store", "hbm", sharding="data")
     b.edge("align_stats", "host")
     b.edge("region_groups", "host")
     b.edge("records_by_group", "host")
@@ -62,7 +66,7 @@ def build_library_graph(cfg: RunConfig) -> GraphSpec:
     b.edge("r1_polished", "host")
     b.edge("merged_consensus", "host")
     b.edge("merged_fasta", "disk")
-    b.edge("cons_store", "hbm")
+    b.edge("cons_store", "hbm", sharding="data")
     b.edge("region_records", "host")
     b.edge("selected_by_region", "host")
     b.edge("region_counts", "host")

@@ -524,15 +524,31 @@ def _find_fastqs(fastq_pass_dir: str) -> list[str]:
     return found
 
 
-def validate_inputs(config_path: str, out=None) -> int:
+def validate_inputs(config_path: str, out=None, as_json: bool = False) -> int:
     """``tcr-consensus-tpu --validate``: parse the config, scan every input
     file (record counts/sizes only — no device work), print a validation
-    report, return 0 when clean / 1 on any problem."""
+    report, return 0 when clean / 1 on any problem.  ``as_json`` swaps the
+    human lines for one machine-readable body (problems + the graftcheck
+    semantic report) with the same exit code."""
+    import json as json_mod
+
     out = out if out is not None else sys.stdout
     problems: list[str] = []
+    graftcheck_body: dict | None = None
 
     def p(*parts):
-        print(*parts, file=out)
+        if not as_json:
+            print(*parts, file=out)
+
+    def finish(rc: int) -> int:
+        if as_json:
+            print(json_mod.dumps({
+                "config": config_path,
+                "ok": rc == 0,
+                "problems": problems,
+                "graftcheck": graftcheck_body,
+            }, indent=2), file=out)
+        return rc
 
     p(f"validate: config {config_path}")
     from ont_tcrconsensus_tpu.pipeline.config import RunConfig
@@ -540,9 +556,10 @@ def validate_inputs(config_path: str, out=None) -> int:
     try:
         cfg = RunConfig.from_json(config_path)
     except (OSError, ValueError, TypeError) as exc:  # TypeError: missing keys
-        p(f"PROBLEM: config failed to load/validate: {exc}")
+        problems.append(f"config failed to load/validate: {exc}")
+        p(f"PROBLEM: {problems[0]}")
         p("validate: FAIL (1 problem)")
-        return 1
+        return finish(1)
 
     # executor knob: a graph-executor config must declare a graph that
     # passes builder validation (cycles, undeclared/dangling edges, hbm
@@ -561,6 +578,32 @@ def validate_inputs(config_path: str, out=None) -> int:
             p(f"validate: stage graph: {len(spec.schedule)} nodes, "
               f"{len(spec.edges)} edges, "
               f"{len(spec.side_sinks())} off-critical-path")
+            # graftcheck: semantic analysis of the built graph (liveness /
+            # donation / placement flow / sharding pairing — graph/check.py,
+            # jax-free). Violations are validation problems; advisories
+            # (the known host round-trips) are informational. Never-crash:
+            # an analyzer bug must not block a run an operator could start.
+            try:
+                from ont_tcrconsensus_tpu.graph import check as graph_check
+
+                report = graph_check.analyze(
+                    spec, graph_check.production_byte_model(cfg))
+                graftcheck_body = report.to_dict()
+                s = report.summary()
+                p(f"validate: graftcheck: {s['verdict']} "
+                  f"({s['violations']} violation(s), "
+                  f"{s['advisories']} advisory(ies)); hbm high-water "
+                  f"~{s['hbm_high_water_bytes_est']} bytes at "
+                  f"{s['hbm_high_water_node']}")
+                for f in report.advisories:
+                    p(f"validate:   graftcheck advisory: {f.kind}: "
+                      f"{f.message}")
+                problems.extend(
+                    f"graftcheck: {f.kind}: {f.message}"
+                    for f in report.violations
+                )
+            except Exception as exc:
+                p(f"validate: WARNING: graftcheck analysis failed: {exc!r}")
 
     from ont_tcrconsensus_tpu.io import fastx
 
@@ -648,6 +691,6 @@ def validate_inputs(config_path: str, out=None) -> int:
         for prob in problems:
             p(f"PROBLEM: {prob}")
         p(f"validate: FAIL ({len(problems)} problem(s))")
-        return 1
+        return finish(1)
     p(f"validate: OK ({len(fastqs)} input file(s), {total_records} records)")
-    return 0
+    return finish(0)

@@ -152,6 +152,11 @@ def build_entry(source: str, telemetry: dict | None = None, *,
             "hbm_high_water_bytes": gauges.get("device.hbm_bytes_in_use"),
             "peak_host_rss_bytes": gauges.get("host.rss_bytes"),
         })
+        # static-analyzer verdict (additive schema: older readers and the
+        # perf gate ignore unknown keys; see test_history garbage test)
+        analysis = telemetry.get("analysis") or {}
+        if isinstance(analysis, dict) and "graftcheck" in analysis:
+            entry["graftcheck"] = analysis["graftcheck"]
     if extra:
         entry.update(extra)
     return entry

@@ -57,6 +57,10 @@ class MetricsRegistry:
         self.graph_meta: dict[str, dict] = {}
         # pool site -> [busy_s, idle_s, window_s, slots]
         self.pools: dict[str, list[float]] = {}
+        # analyzer name -> verdict summary (e.g. "graftcheck" ->
+        # graph.check.Report.summary(); static findings ride the same
+        # telemetry artifact so the history ledger tracks them per run)
+        self.analysis: dict[str, dict] = {}
         # stage label -> [n_dispatch, n_get, host_s, block_s] (the
         # dispatch-tax split re-keyed by the active stage span, so the
         # per-node rollup needs no trace replay)
@@ -154,6 +158,10 @@ class MetricsRegistry:
             p[2] += window_s
             p[3] = max(p[3], slots)
 
+    def analysis_set(self, name: str, summary: dict) -> None:
+        with self._lock:
+            self.analysis[name] = dict(summary)
+
     # --- roll-up -----------------------------------------------------------
 
     def summary(self) -> dict:
@@ -198,6 +206,10 @@ class MetricsRegistry:
                         "host_s": round(v[2], 3), "block_s": round(v[3], 3)}
                     for k, v in sorted(self.dispatch_stages.items())
                 }
+            if self.analysis:
+                out["analysis"] = {
+                    k: dict(self.analysis[k]) for k in sorted(self.analysis)
+                }
             pool = None
             if self.pools:
                 # one merged busy/idle split (a run has one overlap pool
@@ -236,6 +248,25 @@ class MetricsRegistry:
                 # host the pool split, so it rides top-level
                 out["overlap_pool"] = pool
             return out
+
+
+# Lock-ownership declaration for graftlint's lock-discipline rule: every
+# mutation of these registries outside `with self._lock:` is a data race
+# (worker threads + the watchdog monitor both feed this object).
+LOCK_OWNERSHIP = {
+    "MetricsRegistry.counters": "_lock",
+    "MetricsRegistry.gauges": "_lock",
+    "MetricsRegistry.hists": "_lock",
+    "MetricsRegistry.stages": "_lock",
+    "MetricsRegistry.dispatch": "_lock",
+    "MetricsRegistry.dispatch_stages": "_lock",
+    "MetricsRegistry.compiles": "_lock",
+    "MetricsRegistry.graph_nodes": "_lock",
+    "MetricsRegistry.graph_edges": "_lock",
+    "MetricsRegistry.graph_meta": "_lock",
+    "MetricsRegistry.pools": "_lock",
+    "MetricsRegistry.analysis": "_lock",
+}
 
 
 # --- process-wide armed registry (same discipline as faults/watchdog) -------
@@ -324,3 +355,11 @@ def pool_add(site: str, *, busy_s: float = 0.0, idle_s: float = 0.0,
     if reg is not None:
         reg.pool_add(site, busy_s=busy_s, idle_s=idle_s, window_s=window_s,
                      slots=slots)
+
+
+def analysis_set(name: str, summary: dict) -> None:
+    """Record a static-analyzer verdict summary (graftcheck) into the
+    telemetry artifact; free no-op when telemetry is off."""
+    reg = _ARMED
+    if reg is not None:
+        reg.analysis_set(name, summary)

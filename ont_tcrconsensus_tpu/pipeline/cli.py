@@ -66,9 +66,9 @@ def main(argv: list[str] | None = None) -> int:
     )
     parser.add_argument(
         "--json", action="store_true",
-        help="With --report: emit the machine-readable report dump instead "
-        "of the human rendering (same artifact resolution rules and exit "
-        "codes).",
+        help="With --report or --validate: emit the machine-readable dump "
+        "instead of the human rendering (same artifact resolution rules "
+        "and exit codes).",
     )
     parser.add_argument(
         "--critical-path", action="store_true",
@@ -81,15 +81,19 @@ def main(argv: list[str] | None = None) -> int:
         "--validate", action="store_true",
         help="Dry-run input validation: parse the config, scan every input "
         "file (record counts/sizes via the tolerant parser — no device "
-        "work, no jax import), audit any existing workdir's stage "
-        "manifests (torn/v1 manifests, full sha256 over completed "
-        "artifacts), print a validation report, and exit non-zero on any "
-        "problem.",
+        "work, no jax import), run the graftcheck semantic analysis over "
+        "the declared stage graph (liveness/donation/placement/sharding "
+        "— violations are problems, known host round-trips are "
+        "advisories), audit any existing workdir's stage manifests "
+        "(torn/v1 manifests, full sha256 over completed artifacts), "
+        "print a validation report, and exit non-zero on any problem.",
     )
     args = parser.parse_args(argv)
 
-    if (args.json or args.critical_path) and not args.report:
-        parser.error("--json/--critical-path are --report options")
+    if args.json and not (args.report or args.validate):
+        parser.error("--json is a --report/--validate option")
+    if args.critical_path and not args.report:
+        parser.error("--critical-path is a --report option")
 
     if args.report:
         # never touches jax: safe on hosts with a wedged device tunnel
@@ -104,7 +108,8 @@ def main(argv: list[str] | None = None) -> int:
         # never touches jax: safe on hosts with a wedged device tunnel
         from ont_tcrconsensus_tpu.io import validate as validate_mod
 
-        return validate_mod.validate_inputs(args.json_config_file)
+        return validate_mod.validate_inputs(args.json_config_file,
+                                            as_json=args.json)
 
     if args.cpu or os.environ.get("TCR_CONSENSUS_FORCE_CPU"):
         import jax

@@ -131,6 +131,16 @@ class StageExecutor:
         self._busy_s = 0.0
         self._pool_recorded = False
 
+    # Lock-ownership declaration for graftlint's lock-discipline rule:
+    # the pool counters are fed by every worker thread's completion
+    # callback, so an unlocked write silently loses busy seconds.
+    LOCK_OWNERSHIP = {
+        "StageExecutor._t_first_submit": "_stats_lock",
+        "StageExecutor._t_last_done": "_stats_lock",
+        "StageExecutor._busy_s": "_stats_lock",
+        "StageExecutor._pool_recorded": "_stats_lock",
+    }
+
     def _note_done(self, worker_seconds: float) -> None:
         with self._stats_lock:
             self._busy_s += worker_seconds

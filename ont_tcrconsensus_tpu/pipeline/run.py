@@ -575,6 +575,18 @@ def _run_library_graph(fastq, lay, cfg, panel, engine, engine_notrim,
         read_batch=read_batch, budget=budget,
     )
     spec = graph_pipeline.build_library_graph(cfg)
+    try:
+        # Static graftcheck verdict rides telemetry.json / the history
+        # ledger, so analyzer findings are tracked per run alongside the
+        # runtime numbers they predict. Never takes down a run.
+        from ont_tcrconsensus_tpu.graph import check as graph_check
+        from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
+
+        report = graph_check.analyze(
+            spec, graph_check.production_byte_model(cfg))
+        obs_metrics.analysis_set("graftcheck", report.summary())
+    except Exception as exc:
+        _log(f"WARNING: graftcheck analysis failed: {exc!r}")
     executor = graph_exec.GraphExecutor(spec, ctx, side_exec=qc_exec)
     results = executor.run({"library_fastq": fastq})
     return results["region_counts"]

@@ -307,6 +307,14 @@ class Watchdog:
         )
 
 
+# Lock-ownership declaration for graftlint's lock-discipline rule: the
+# registry is mutated by guarded stage threads and raced by the monitor,
+# and _on_hard's cancel-safety proof relies on every write being locked.
+LOCK_OWNERSHIP = {
+    "Watchdog._entries": "_lock",
+}
+
+
 # --- process-wide active watchdog (same discipline as faults/retry) ---------
 
 _ACTIVE: Watchdog | None = None

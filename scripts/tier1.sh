@@ -29,12 +29,36 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 
-echo "--- static analysis: graftlint ---"
-python -m tools.graftlint ont_tcrconsensus_tpu tests scripts tools
+echo "--- static analysis: graftlint (new findings only; known ones live"
+echo "    in tools/graftlint/baseline.json with justifications) ---"
+python -m tools.graftlint ont_tcrconsensus_tpu tests scripts tools \
+    --baseline tools/graftlint/baseline.json
 lrc=$?
 if [ "$lrc" -ne 0 ]; then
     echo "graftlint FAILED (rc=$lrc)" >&2
     exit "$lrc"
+fi
+
+echo "--- static analysis: graftcheck (semantic graph-contract analyzer;"
+echo "    jax-free — the run itself proves the production GraphSpec builds"
+echo "    and analyzes without jax; --expect pins the known host"
+echo "    round-trips so a new one fails CI) ---"
+python -m tools.graftcheck --expect
+gcrc=$?
+if [ "$gcrc" -ne 0 ]; then
+    echo "graftcheck FAILED (rc=$gcrc)" >&2
+    exit "$gcrc"
+fi
+# exit-code/JSON parity: the --json body must carry the same exit_code the
+# human run returned, so machine consumers never disagree with CI
+gcjson=$(python -m tools.graftcheck --expect --json)
+jrc=$?
+jbody_rc=$(printf '%s' "$gcjson" | python -c \
+    'import json,sys; print(json.load(sys.stdin)["exit_code"])')
+if [ "$jrc" -ne "$gcrc" ] || [ "$jbody_rc" != "$gcrc" ]; then
+    echo "graftcheck --json parity FAILED (human rc=$gcrc, json rc=$jrc," \
+         "body exit_code=$jbody_rc)" >&2
+    exit 1
 fi
 
 if command -v ruff >/dev/null 2>&1; then

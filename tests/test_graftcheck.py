@@ -1,0 +1,386 @@
+"""graftcheck (graph/check.py + tools/graftcheck): the semantic analyzer
+proves liveness/donation/placement/sharding properties of built graphs.
+
+Fixture graphs exercise each analysis against hand-computed expectations
+(a diamond with an explicit byte model pins the exact live set and
+high-water per step); the acceptance tests run the REAL production graph
+and compare against the committed expected-findings list — the same
+comparison tier-1 stage 0 makes — and prove the whole analysis imports
+nothing from jax (a poisoned-import subprocess).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from ont_tcrconsensus_tpu.graph import check  # noqa: E402
+from ont_tcrconsensus_tpu.graph import pipeline as graph_pipeline  # noqa: E402
+from ont_tcrconsensus_tpu.graph.ir import GraphBuilder  # noqa: E402
+from ont_tcrconsensus_tpu.pipeline.config import RunConfig  # noqa: E402
+from tools.graftcheck.cli import DEFAULT_EXPECT  # noqa: E402
+from tools.graftcheck.cli import main as graftcheck_main  # noqa: E402
+
+
+# Fixture node names pass through variables, never literals: the
+# graftlint graph/obs rules police name literals against the production
+# registries, and kind comparisons use these constants for the same
+# reason (the chaos-kind rule polices `x.kind == <literal>` shapes).
+N_LOAD, N_LEFT, N_RIGHT, N_JOIN = "load", "left", "right", "join"
+N_UP, N_DOWN, N_HOSTWORK, N_REUP, N_SINK = (
+    "up", "down", "host_work", "re_up", "sink")
+N_ONE, N_TWO, N_XFORM, N_USE, N_WORK = "one", "two", "xform", "use", "work"
+K_DONATION = "donation-hazard"
+K_TRIP = "placement-round-trip"
+K_RESHARD = "reshard-site"
+
+
+def _cfg(**kw) -> RunConfig:
+    # placeholder paths: nothing in graph construction stats the filesystem
+    return RunConfig(reference_file="reference.fasta",
+                     fastq_pass_dir="fastq_pass", **kw)
+
+
+def kinds_of(report) -> set[str]:
+    return {f.kind for f in report.findings}
+
+
+# ---------------------------------------------------------------------------
+# liveness: diamond fixture with an explicit byte model
+
+
+def diamond_spec():
+    """load -> (left, right) -> join, all on hbm; `mid_l`/`mid_r` are the
+    diamond arms, `out` the joined result (host so it may be a result)."""
+    b = GraphBuilder("diamond")
+    b.input("src", "disk")
+    b.edge("base", "hbm")
+    b.edge("mid_l", "hbm")
+    b.edge("mid_r", "hbm")
+    b.edge("out", "host")
+    b.add_node(N_LOAD, inputs=("src",), outputs=("base",))
+    b.add_node(N_LEFT, inputs=("base",), outputs=("mid_l",))
+    b.add_node(N_RIGHT, inputs=("base",), outputs=("mid_r",))
+    b.add_node(N_JOIN, inputs=("mid_l", "mid_r"), outputs=("out",))
+    b.result("out")
+    return b.build()
+
+
+def test_diamond_liveness_and_high_water():
+    model = {"base": 100, "mid_l": 30, "mid_r": 5}
+    report = check.analyze(diamond_spec(), model)
+    by_node = {row["node"]: row for row in report.liveness}
+    # base lives until BOTH arms consumed it; the executor drops it at its
+    # last consumer ('right', declaration order == schedule order)
+    assert by_node[N_LOAD]["live_hbm"] == ["base"]
+    assert by_node[N_LOAD]["hbm_bytes_est"] == 100
+    assert by_node[N_LEFT]["live_hbm"] == ["base", "mid_l"]
+    assert by_node[N_LEFT]["hbm_bytes_est"] == 130
+    assert by_node[N_RIGHT]["live_hbm"] == ["base", "mid_l", "mid_r"]
+    assert by_node[N_RIGHT]["hbm_bytes_est"] == 135
+    assert by_node[N_JOIN]["live_hbm"] == ["mid_l", "mid_r"]
+    assert report.hbm_high_water_bytes == 135
+    assert report.hbm_high_water_node == N_RIGHT
+    # donation: base's buffer may be donated into 'right' (its last
+    # consumer), both arms into 'join'
+    assert report.donation_eligible == {
+        N_RIGHT: ["base"], N_JOIN: ["mid_l", "mid_r"],
+    }
+    # the diamond is donation-safe and device-resident end to end
+    assert report.verdict == "clean"
+    assert report.summary()["donation_safe"] is True
+
+
+def test_liveness_zero_byte_model_still_tracks_sets():
+    report = check.analyze(diamond_spec())
+    assert [row["hbm_bytes_est"] for row in report.liveness] == [0, 0, 0, 0]
+    assert {tuple(row["live_hbm"]) for row in report.liveness} == {
+        ("base",), ("base", "mid_l"), ("base", "mid_l", "mid_r"),
+        ("mid_l", "mid_r"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# donation hazards
+
+
+def test_hbm_result_edge_is_donation_hazard():
+    b = GraphBuilder("bad-result")
+    b.input("src", "disk")
+    b.edge("dev", "hbm")
+    b.add_node(N_LOAD, inputs=("src",), outputs=("dev",))
+    b.result("dev")
+    report = check.analyze(b.build())
+    assert report.verdict == "violations"
+    (f,) = report.violations
+    assert f.kind == K_DONATION and f.subject == "dev"
+    assert "graph result" in f.message
+    assert report.summary()["donation_safe"] is False
+
+
+def test_unconsumed_hbm_edge_is_donation_hazard():
+    b = GraphBuilder("bad-leak")
+    b.input("src", "disk")
+    b.edge("dev", "hbm")
+    b.edge("leak", "hbm")
+    b.edge("out", "host")
+    b.add_node(N_LOAD, inputs=("src",), outputs=("dev", "leak"))
+    b.add_node(N_USE, inputs=("dev",), outputs=("out",))
+    b.result("out")
+    report = check.analyze(b.build())
+    hazards = [f for f in report.violations if f.kind == K_DONATION]
+    assert [f.subject for f in hazards] == ["leak"]
+    assert "no consumer" in hazards[0].message
+
+
+# ---------------------------------------------------------------------------
+# placement flow: hbm -> host -> hbm round-trips
+
+
+def test_host_round_trip_named_with_full_path():
+    b = GraphBuilder("trip")
+    b.input("src", "disk")
+    b.edge("dev_a", "hbm")
+    b.edge("staged", "host")
+    b.edge("massaged", "host")
+    b.edge("dev_b", "hbm")
+    b.edge("out", "host")
+    b.add_node(N_UP, inputs=("src",), outputs=("dev_a",))
+    b.add_node(N_DOWN, inputs=("dev_a",), outputs=("staged",))
+    b.add_node(N_HOSTWORK, inputs=("staged",), outputs=("massaged",))
+    b.add_node(N_REUP, inputs=("massaged",), outputs=("dev_b",))
+    b.add_node(N_SINK, inputs=("dev_b",), outputs=("out",))
+    b.result("out")
+    report = check.analyze(b.build())
+    trips = [f for f in report.advisories
+             if f.kind == K_TRIP]
+    # 'down' is a device node (touches dev_a); its host output flows
+    # through the host-only 'host_work' into device node 're_up'
+    assert [f.path for f in trips] == [
+        (N_DOWN, "staged", N_HOSTWORK, "massaged", N_REUP),
+    ]
+    assert trips[0].severity == "advisory"
+    assert N_REUP in trips[0].message
+    # advisories alone never fail: verdict is non-clean but not violating
+    assert report.verdict == "advisories"
+    assert report.violations == []
+
+
+def test_pure_host_flow_is_not_a_round_trip():
+    b = GraphBuilder("hostonly")
+    b.input("src", "disk")
+    b.edge("a", "host")
+    b.edge("b", "host")
+    b.add_node(N_ONE, inputs=("src",), outputs=("a",))
+    b.add_node(N_TWO, inputs=("a",), outputs=("b",))
+    b.result("b")
+    report = check.analyze(b.build())
+    assert report.findings == []
+    assert report.verdict == "clean"
+
+
+# ---------------------------------------------------------------------------
+# sharding pairing (ROADMAP-2 groundwork)
+
+
+def test_sharding_mismatch_is_reshard_site():
+    b = GraphBuilder("reshard")
+    b.input("src", "disk")
+    b.edge("ina", "hbm", sharding="data")
+    b.edge("outa", "hbm", sharding="model")
+    b.edge("res", "host")
+    b.add_node(N_UP, inputs=("src",), outputs=("ina",))
+    b.add_node(N_XFORM, inputs=("ina",), outputs=("outa",))
+    b.add_node(N_DOWN, inputs=("outa",), outputs=("res",))
+    b.result("res")
+    report = check.analyze(b.build())
+    sites = [f for f in report.violations if f.kind == K_RESHARD]
+    assert [f.subject for f in sites] == [N_XFORM]
+    assert "['data']" in sites[0].message and "['model']" in sites[0].message
+
+
+def test_matching_or_undeclared_sharding_is_clean():
+    b = GraphBuilder("sharded-ok")
+    b.input("src", "disk")
+    b.edge("ina", "hbm", sharding="data")
+    b.edge("outa", "hbm", sharding="data")
+    b.edge("bare", "hbm")  # undeclared sharding never pairs
+    b.edge("res", "host")
+    b.add_node(N_UP, inputs=("src",), outputs=("ina",))
+    b.add_node(N_XFORM, inputs=("ina",), outputs=("outa", "bare"))
+    b.add_node(N_DOWN, inputs=("outa", "bare"), outputs=("res",))
+    b.result("res")
+    report = check.analyze(b.build())
+    assert [f for f in report.findings if f.kind == K_RESHARD] == []
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the production graph
+
+
+def test_production_graph_matches_committed_expected_list():
+    cfg = _cfg()
+    spec = graph_pipeline.build_library_graph(cfg)
+    report = check.analyze(spec, check.production_byte_model(cfg))
+    with open(DEFAULT_EXPECT, encoding="utf-8") as fh:
+        expected = json.load(fh)
+    want = {(d["kind"], d["subject"], tuple(d["path"]))
+            for d in expected["findings"]}
+    got = {f.key() for f in report.findings}
+    assert got == want, (
+        "production findings drifted from tools/graftcheck/"
+        "expected_production.json — rerun `python -m tools.graftcheck "
+        "--write-expect tools/graftcheck/expected_production.json` and "
+        "review the diff"
+    )
+    # the committed list is the ROADMAP-1 worklist: every entry is a
+    # device->host round-trip advisory, none a violation
+    assert report.violations == []
+    assert all(f.kind == K_TRIP for f in report.advisories)
+    # the round1->round2 hand-off (polish -> consensus -> round2 assign)
+    # must be named until the hand-off goes device-resident
+    assert any("round2_fused_assign" in f.path for f in report.advisories)
+
+
+def test_production_liveness_reports_high_water():
+    cfg = _cfg()
+    spec = graph_pipeline.build_library_graph(cfg)
+    report = check.analyze(spec, check.production_byte_model(cfg, n_reads=8))
+    assert len(report.liveness) == len(spec.schedule)
+    # read_store (8 reads * 2 planes * max_read_length) dominates
+    row = 2 * cfg.max_read_length
+    assert report.hbm_high_water_bytes >= 8 * row
+    assert report.hbm_high_water_node is not None
+    # every step reports a sorted live set
+    for step in report.liveness:
+        assert step["live_hbm"] == sorted(step["live_hbm"])
+
+
+def test_analysis_is_jax_free_under_poisoned_import():
+    """The whole CLI path must run with jax IMPOSSIBLE to import."""
+    code = (
+        "import sys\n"
+        "class _Poison:\n"
+        "    def find_spec(self, name, *a, **k):\n"
+        "        if name == 'jax' or name.startswith('jax.'):\n"
+        "            raise ImportError('jax import poisoned by test')\n"
+        "sys.meta_path.insert(0, _Poison())\n"
+        "from tools.graftcheck.cli import main\n"
+        "sys.exit(main(['--expect']))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "internal error" not in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+
+
+def test_cli_human_and_json_agree(capsys):
+    assert graftcheck_main([]) == 0
+    human = capsys.readouterr().out
+    assert "hbm high-water" in human
+    assert "graftcheck:" in human
+    assert graftcheck_main(["--json"]) == 0
+    body = json.loads(capsys.readouterr().out)
+    assert body["exit_code"] == 0
+    assert body["summary"]["verdict"] == "advisories"
+    assert body["summary"]["violations"] == 0
+    assert len(body["findings"]) == body["summary"]["advisories"]
+    assert body["liveness"]
+
+
+def test_cli_expect_drift_fails(tmp_path, capsys):
+    # a tampered expected list (one entry removed) must fail both ways
+    with open(DEFAULT_EXPECT, encoding="utf-8") as fh:
+        expected = json.load(fh)
+    assert expected["findings"], "committed list unexpectedly empty"
+    tampered = dict(expected, findings=expected["findings"][1:])
+    p = tmp_path / "expect.json"
+    p.write_text(json.dumps(tampered))
+    assert graftcheck_main(["--expect", str(p)]) == 1
+    err = capsys.readouterr().err
+    assert "NEW finding not in the expected list" in err
+    # ...and the symmetric direction: an extra (bogus) expected entry
+    bogus = dict(expected)
+    bogus["findings"] = expected["findings"] + [
+        {"kind": K_TRIP, "subject": "ghost", "path": ["ghost"]}
+    ]
+    p.write_text(json.dumps(bogus))
+    assert graftcheck_main(["--expect", str(p)]) == 1
+    assert "no longer reported" in capsys.readouterr().err
+
+
+def test_cli_never_crashes_on_bad_inputs(tmp_path, capsys):
+    assert graftcheck_main(["--config", str(tmp_path / "nope.json")]) == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert graftcheck_main(["--config", str(bad)]) == 2
+    err = capsys.readouterr().err
+    assert "Traceback" not in err
+
+
+def test_cli_write_expect_round_trips(tmp_path, capsys):
+    out = tmp_path / "expect.json"
+    assert graftcheck_main(["--write-expect", str(out)]) == 0
+    capsys.readouterr()
+    assert graftcheck_main(["--expect", str(out)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# telemetry plumbing: summary -> telemetry.json -> history ledger
+
+
+def test_summary_lands_in_telemetry_and_history_entry():
+    from ont_tcrconsensus_tpu.obs import history, metrics
+
+    cfg = _cfg()
+    spec = graph_pipeline.build_library_graph(cfg)
+    report = check.analyze(spec, check.production_byte_model(cfg))
+    reg = metrics.arm()
+    try:
+        metrics.analysis_set("graftcheck", report.summary())
+        telemetry = reg.summary()
+    finally:
+        metrics.disarm()
+    assert telemetry["analysis"]["graftcheck"]["verdict"] == "advisories"
+    entry = history.build_entry("test", telemetry)
+    assert entry["graftcheck"]["verdict"] == "advisories"
+    assert entry["graftcheck"]["violations"] == 0
+    assert entry["graftcheck"]["hbm_high_water_node"] is not None
+
+
+def test_analysis_set_is_noop_when_disarmed():
+    from ont_tcrconsensus_tpu.obs import metrics
+
+    metrics.disarm()
+    metrics.analysis_set("graftcheck", {"verdict": "clean"})  # must not raise
+    assert metrics.registry() is None
+
+
+# ---------------------------------------------------------------------------
+# builder guards that feed graftcheck's graph-invalid path
+
+
+def test_edge_node_name_collision_is_named_problem():
+    from ont_tcrconsensus_tpu.graph.ir import GraphValidationError
+
+    b = GraphBuilder("clash")
+    b.input("src", "disk")
+    b.edge(N_WORK, "host")
+    b.add_node(N_WORK, inputs=("src",), outputs=())
+    with pytest.raises(GraphValidationError) as exc:
+        b.build()
+    assert any("collides with a node" in p for p in exc.value.problems)

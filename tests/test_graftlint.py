@@ -514,11 +514,266 @@ def test_cli_exit_codes_and_json(tmp_path, capsys):
 
 
 # ---------------------------------------------------------------------------
-# the shipped tree is clean (acceptance)
+# rule family: donation-use-after-donate
 
 
-def test_shipped_tree_is_clean():
-    paths = [os.path.join(REPO, p)
-             for p in ("ont_tcrconsensus_tpu", "tests", "scripts", "tools")]
-    findings = run_paths(paths)
-    assert findings == [], "\n".join(f.format() for f in findings)
+def test_donation_use_after_donate_fires(tmp_path):
+    findings = lint(tmp_path, {"bad.py": (
+        "import jax\n"
+        "step = jax.jit(lambda x: x + 1, donate_argnums=(0,))\n"
+        "def go(buf):\n"
+        "    out = step(buf)\n"
+        "    print(buf.sum())\n"
+        "    return out\n"
+    )})
+    assert rules_of(findings) == {"donation-use-after-donate"}
+    (f,) = findings
+    assert "`buf` was donated to `step` on line 4" in f.message
+
+
+def test_donation_decorated_and_inline_forms_fire(tmp_path):
+    findings = lint(tmp_path, {"bad.py": (
+        "from functools import partial\n"
+        "import jax\n"
+        "@partial(jax.jit, donate_argnums=(1,))\n"
+        "def step(carry, buf):\n"
+        "    return carry + buf\n"
+        "def go(c, buf):\n"
+        "    out = step(c, buf)\n"
+        "    inline = jax.jit(step, donate_argnums=(0,))(c, buf)\n"
+        "    return out + inline + buf\n"
+    )})
+    # line 7 donates buf (decorated step, position 1); the line-8 inline
+    # call loads it while poisoned AND line 9 loads it again — 2 findings
+    assert sum(f.rule == "donation-use-after-donate" for f in findings) == 2
+    assert {f.line for f in findings} == {8, 9}
+    assert all("`buf` was donated" in f.message for f in findings)
+
+
+def test_donation_rebind_and_reorder_are_clean(tmp_path):
+    findings = lint(tmp_path, {"ok.py": (
+        "import jax\n"
+        "step = jax.jit(lambda x: x + 1, donate_argnums=(0,))\n"
+        "def rebind(buf):\n"
+        "    buf = step(buf)\n"
+        "    return buf.sum()\n"
+        "def reorder(buf):\n"
+        "    total = buf.sum()\n"
+        "    return step(buf), total\n"
+        "def no_donation(buf):\n"
+        "    g = jax.jit(lambda x: x)\n"
+        "    out = g(buf)\n"
+        "    return out, buf.sum()\n"
+    )})
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# rule family: recompile-hazard
+
+
+def test_recompile_hazard_pad_to_and_jnp_shape_fire(tmp_path):
+    findings = lint(tmp_path, {"bad.py": (
+        "import jax.numpy as jnp\n"
+        "def pad(xs, pad_batch):\n"
+        "    n = max(len(x) for x in xs)\n"
+        "    m = n + 7\n"
+        "    z = jnp.zeros((m, 4))\n"
+        "    return pad_batch(xs, pad_to=n), z\n"
+    )})
+    assert sum(f.rule == "recompile-hazard" for f in findings) == 2
+    assert {f.line for f in findings} == {5, 6}
+
+
+def test_recompile_hazard_quantizers_sanitize(tmp_path):
+    findings = lint(tmp_path, {"ok.py": (
+        "import jax.numpy as jnp\n"
+        "DEFAULT_WIDTHS = (64, 128, 256)\n"
+        "def pad(xs, pad_batch, pow2_ceil):\n"
+        "    n = pow2_ceil(max(len(x) for x in xs))\n"
+        "    w = next(w for w in DEFAULT_WIDTHS if w >= len(xs))\n"
+        "    z = jnp.zeros((n, w))\n"
+        "    return pad_batch(xs, pad_to=w)\n"
+        "def host_ok(xs, np):\n"
+        "    return np.zeros((len(xs), 4))\n"
+    )})
+    assert [f for f in findings if f.rule == "recompile-hazard"] == []
+
+
+def test_recompile_hazard_taint_flows_into_branches(tmp_path):
+    """Assignments inside compound statements poison sinks after them."""
+    findings = lint(tmp_path, {"bad.py": (
+        "import jax.numpy as jnp\n"
+        "def f(xs, flag):\n"
+        "    if flag:\n"
+        "        n = len(xs)\n"
+        "    else:\n"
+        "        n = 8\n"
+        "    return jnp.zeros(n)\n"
+    )})
+    assert sum(f.rule == "recompile-hazard" for f in findings) == 1
+
+
+# ---------------------------------------------------------------------------
+# rule family: lock-discipline
+
+
+_LOCK_FIXTURE_HEADER = (
+    "import threading\n"
+    'LOCK_OWNERSHIP = {"Reg.counters": "_lock"}\n'
+    "class Reg:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self.counters = {}\n"
+)
+
+
+def test_lock_discipline_unlocked_mutations_fire(tmp_path):
+    findings = lint(tmp_path, {"bad.py": _LOCK_FIXTURE_HEADER + (
+        "    def bad(self, k):\n"
+        "        self.counters[k] = 1\n"
+        "        self.counters.update(a=2)\n"
+        "        del self.counters[k]\n"
+    )})
+    assert sum(f.rule == "lock-discipline" for f in findings) == 3
+
+
+def test_lock_discipline_locked_reads_and_conventions_clean(tmp_path):
+    findings = lint(tmp_path, {"ok.py": _LOCK_FIXTURE_HEADER + (
+        "    def good(self, k):\n"
+        "        with self._lock:\n"
+        "            self.counters[k] = 1\n"
+        "            self.counters.update(a=2)\n"
+        "    def read(self):\n"
+        "        return len(self.counters)\n"
+        "    def _bump_locked(self, k):\n"
+        "        self.counters[k] = 1\n"
+        "    def unowned(self):\n"
+        "        self.other = {}\n"
+    )})
+    assert findings == []
+
+
+def test_lock_discipline_wrong_lock_and_nested_def_fire(tmp_path):
+    findings = lint(tmp_path, {"bad.py": _LOCK_FIXTURE_HEADER + (
+        "    def wrong(self, k):\n"
+        "        with self._other_lock:\n"
+        "            self.counters[k] = 1\n"
+        "    def deferred(self, k):\n"
+        "        with self._lock:\n"
+        "            def cb():\n"
+        "                self.counters[k] = 1\n"
+        "            return cb\n"
+    )})
+    # holding the WRONG lock doesn't count, and a nested def runs later
+    # (possibly on another thread) so the held set must not flow in
+    assert sum(f.rule == "lock-discipline" for f in findings) == 2
+
+
+def test_lock_discipline_noop_without_ownership_table(tmp_path):
+    findings = lint(tmp_path, {"free.py": (
+        "class Reg:\n"
+        "    def bad(self, k):\n"
+        "        self.counters = {}\n"
+    )})
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# --baseline: known findings don't fail, new ones do
+
+
+def _baseline_fixture(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax.numpy as jnp\n"
+        "def f(xs):\n"
+        "    n = len(xs)\n"
+        "    return jnp.zeros(n)\n"
+    )
+    return bad
+
+
+def test_baseline_suppresses_known_and_fails_new(tmp_path, capsys):
+    _baseline_fixture(tmp_path)
+    base = tmp_path / "baseline.json"
+    assert graftlint_main([str(tmp_path), "--write-baseline", str(base)]) == 0
+    # the recorded finding no longer fails the run...
+    assert graftlint_main([str(tmp_path), "--baseline", str(base)]) == 0
+    out = capsys.readouterr().out
+    assert "[baselined]" in out
+    # ...but a NEW finding still does, reported alongside the baselined one
+    (tmp_path / "new.py").write_text("import os\n")
+    assert graftlint_main([str(tmp_path), "--baseline", str(base)]) == 1
+    out = capsys.readouterr().out
+    assert "unused-import" in out and "[baselined]" in out
+
+
+def test_baseline_stale_entry_reported_not_fatal(tmp_path, capsys):
+    bad = _baseline_fixture(tmp_path)
+    base = tmp_path / "baseline.json"
+    assert graftlint_main([str(tmp_path), "--write-baseline", str(base)]) == 0
+    bad.write_text("import jax.numpy as jnp\nprint(jnp)\n")  # fix the finding
+    assert graftlint_main([str(tmp_path), "--baseline", str(base)]) == 0
+    assert "stale baseline entry" in capsys.readouterr().err
+
+
+def test_baseline_survives_line_drift(tmp_path):
+    bad = _baseline_fixture(tmp_path)
+    base = tmp_path / "baseline.json"
+    assert graftlint_main([str(tmp_path), "--write-baseline", str(base)]) == 0
+    bad.write_text("# a comment shifting every line\n" + bad.read_text())
+    assert graftlint_main([str(tmp_path), "--baseline", str(base)]) == 0
+
+
+def test_baseline_unreadable_is_usage_error(tmp_path, capsys):
+    _baseline_fixture(tmp_path)
+    assert graftlint_main(
+        [str(tmp_path), "--baseline", str(tmp_path / "nope.json")]) == 2
+    assert "cannot read baseline" in capsys.readouterr().err
+
+
+def test_baseline_json_output_splits_new_and_known(tmp_path, capsys):
+    import json as _json
+
+    _baseline_fixture(tmp_path)
+    base = tmp_path / "baseline.json"
+    assert graftlint_main([str(tmp_path), "--write-baseline", str(base)]) == 0
+    (tmp_path / "new.py").write_text("import os\n")
+    capsys.readouterr()
+    assert graftlint_main(
+        ["--json", str(tmp_path), "--baseline", str(base)]) == 1
+    body = _json.loads(capsys.readouterr().out)
+    assert body["count"] == 1
+    assert body["findings"][0]["rule"] == "unused-import"
+    assert [f["rule"] for f in body["baselined"]] == ["recompile-hazard"]
+    assert body["stale_baseline"] == []
+
+
+# ---------------------------------------------------------------------------
+# the shipped tree is clean (acceptance; known findings are baselined
+# with justifications in tools/graftlint/baseline.json)
+
+
+def test_shipped_tree_is_clean_modulo_baseline(monkeypatch):
+    from tools.graftlint.core import apply_baseline, load_baseline
+
+    # repo-relative paths: the baseline records findings exactly as the
+    # tier-1 gate produces them (run from the repo root)
+    monkeypatch.chdir(REPO)
+    findings = run_paths(["ont_tcrconsensus_tpu", "tests", "scripts",
+                          "tools"])
+    known = load_baseline(
+        os.path.join(REPO, "tools", "graftlint", "baseline.json"))
+    new, baselined, stale = apply_baseline(findings, known)
+    assert new == [], "\n".join(f.format() for f in new)
+    # the baseline file is exact: no stale entries, and every entry
+    # carries a human justification
+    assert stale == set(), stale
+    with open(os.path.join(REPO, "tools", "graftlint", "baseline.json"),
+              encoding="utf-8") as fh:
+        import json as _json
+
+        body = _json.load(fh)
+    assert all(e.get("justification") for e in body["findings"])
+    assert len(baselined) == len(body["findings"])

@@ -155,6 +155,44 @@ def test_builder_duplicate_declarations_and_producer():
     assert "produced by both" in text
 
 
+def test_builder_rejects_edge_name_colliding_with_node():
+    b = GraphBuilder("clash")
+    b.input("src", "disk")
+    b.edge(N_LOAD, "host")  # same name as the node below
+    b.add_node(N_LOAD, None, inputs=("src",), outputs=(N_LOAD,))
+    b.result(N_LOAD)
+    with pytest.raises(GraphValidationError) as exc:
+        b.build()
+    assert any("collides with a node of the same name" in p
+               for p in exc.value.problems)
+
+
+def test_builder_sharding_only_on_hbm_and_described():
+    b = GraphBuilder("sh")
+    b.input("src", "disk")
+    b.edge("x", "hbm", sharding="data")
+    b.edge("y", "host", sharding="data")   # host edges have no layout
+    b.edge("z", "hbm", sharding="")        # empty spec is a typo
+    b.add_node(N_LOAD, None, inputs=("src",), outputs=("x", "y", "z"))
+    b.result("x", "y", "z")
+    with pytest.raises(GraphValidationError) as exc:
+        b.build()
+    text = _problems(exc)
+    assert "declared on a 'host' edge" in text
+    assert "sharding spec must be a non-empty string" in text
+    # the valid declaration survives and shows up in describe()
+    ok = GraphBuilder("sh-ok")
+    ok.input("src", "disk")
+    ok.edge("x", "hbm", sharding="data")
+    ok.edge("out", "host")
+    ok.add_node(N_LOAD, None, inputs=("src", "x"), outputs=("out",))
+    ok.add_node(N_COMPUTE, None, inputs=("src",), outputs=("x",))
+    ok.result("out")
+    spec = ok.build()
+    assert spec.edges["x"].sharding == "data"
+    assert spec.describe()["shardings"] == {"x": "data"}
+
+
 def _resume_chain(h_placement: str, provides=("e2",), reload_fn="default"):
     """src -> load -> resume(disk artifact + crossing edge) -> tail."""
     b = GraphBuilder("res")

@@ -126,6 +126,35 @@ def test_build_entry_rolls_up_telemetry_summary():
     assert bare["reads_per_sec"] == 12.5 and "duration_s" not in bare
 
 
+def test_build_entry_lifts_graftcheck_analysis():
+    """The graftcheck verdict summary rides telemetry['analysis'] into the
+    ledger entry — additive schema, absent when the analyzer didn't run."""
+    tele = {"duration_s": 1.0,
+            "analysis": {"graftcheck": {"verdict": "advisories",
+                                        "violations": 0, "advisories": 7}}}
+    e = history.build_entry("run", tele)
+    assert e["graftcheck"]["verdict"] == "advisories"
+    assert history.build_entry("run", {"duration_s": 1.0}).get(
+        "graftcheck") is None
+    # a garbage analysis section degrades to absence, never a crash
+    weird = history.build_entry("run", {"duration_s": 1.0,
+                                        "analysis": "torn-string"})
+    assert weird.get("graftcheck") is None
+
+
+def test_gate_tolerates_graftcheck_field_and_garbage_values():
+    """Entries carrying the analyzer field — even with garbage in it —
+    must neither crash the gate nor change its verdict."""
+    entries = [dict(_entry(duration_s=10.0),
+                    graftcheck={"verdict": "advisories"}) for _ in range(3)]
+    entries += [dict(_entry(duration_s=10.0), graftcheck="garbage"),
+                dict(_entry(duration_s=10.0), graftcheck=[1, 2])]
+    current = dict(_entry(duration_s=10.0),
+                   graftcheck={"verdict": "violations"})
+    res = history.evaluate_gate(entries, current)
+    assert res.status == "pass" and res.n_baseline == 5
+
+
 # ---------------------------------------------------------------------------
 # gate math on synthetic ledgers
 

@@ -126,6 +126,9 @@ def test_pipeline_mesh_rnn_counts_exact(sim_library, tmp_path, polish_method):
     assert results["barcode01"] == lib.true_counts
 
 
+@pytest.mark.slow  # ~35s: a full e2e run whose only NEW assertion is the
+# profiler artifact glob — result correctness is already pinned by the
+# non-slow e2e tests in this file; reruns in the slow suite.
 def test_pipeline_profiler_trace_written(sim_library, tmp_path):
     """profile_trace_dir wraps the run in a jax.profiler trace (device-level
     observability; SURVEY §5 tracing row) without touching the results."""

@@ -1,0 +1,24 @@
+"""graftcheck — semantic graph-contract analyzer (CLI).
+
+The analysis core lives in :mod:`ont_tcrconsensus_tpu.graph.check` (in
+the package, so ``tcr-consensus-tpu --validate`` ships it); this package
+is the repo-side command-line front end:
+
+    python -m tools.graftcheck [--config run.json] [--n-reads N]
+                               [--json] [--expect FILE] [--write-expect FILE]
+
+It builds the *production* GraphSpec (default config, or ``--config``)
+entirely jax-free and prints the per-step live-hbm table, the donation
+report, and every finding.  ``--expect`` compares the findings against a
+committed expected list (tools/graftcheck/expected_production.json) and
+fails on drift in either direction — the regression guard tier1.sh
+stage 0 runs: a new implicit host round-trip fails CI, and so does
+fixing one without updating the worklist.
+
+Exit codes: 0 clean/advisories-as-expected, 1 violations or expected-
+list drift, 2 usage or internal error (never a traceback).
+"""
+
+from tools.graftcheck.cli import main
+
+__all__ = ["main"]

@@ -1,0 +1,8 @@
+"""``python -m tools.graftcheck`` entry point."""
+
+import sys
+
+from tools.graftcheck.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,152 @@
+"""graftcheck CLI: build the production graph jax-free, analyze, report.
+
+See :mod:`tools.graftcheck` for the contract and exit codes.  The
+expected-findings comparison matches on ``(kind, subject, path)`` — not
+message text — so wording edits don't churn the committed list while any
+real finding added or removed does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+DEFAULT_EXPECT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "expected_production.json")
+
+
+def _build_spec(config_path: str | None):
+    """(cfg, spec, problems): builder problems become findings dicts."""
+    from ont_tcrconsensus_tpu.graph.ir import GraphValidationError
+    from ont_tcrconsensus_tpu.graph.pipeline import build_library_graph
+    from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+
+    if config_path is not None:
+        cfg = RunConfig.from_json(config_path)
+    else:
+        # Placeholder inputs: the graph shape only depends on flow-control
+        # knobs, and nothing here stats the filesystem.
+        cfg = RunConfig(reference_file="reference.fasta",
+                        fastq_pass_dir="fastq_pass")
+    try:
+        return cfg, build_library_graph(cfg), []
+    except GraphValidationError as exc:
+        return cfg, None, list(exc.problems)
+
+
+def _finding_key(d: dict) -> tuple:
+    return (d["kind"], d["subject"], tuple(d.get("path", ())))
+
+
+def _compare_expected(findings: list[dict], expect_path: str,
+                      ) -> tuple[list[str], int]:
+    """Human lines + exit contribution (1 on drift) for ``--expect``."""
+    try:
+        with open(expect_path, encoding="utf-8") as fh:
+            expected = json.load(fh)
+    except (OSError, ValueError) as exc:
+        return [f"graftcheck: cannot read expected list {expect_path}: "
+                f"{exc}"], 2
+    want = {_finding_key(d) for d in expected.get("findings", [])}
+    got = {_finding_key(d) for d in findings}
+    lines = []
+    for key in sorted(want - got):
+        lines.append(
+            f"graftcheck: expected finding no longer reported: {key} — "
+            "fixed? update the expected list"
+        )
+    for key in sorted(got - want):
+        lines.append(
+            f"graftcheck: NEW finding not in the expected list: {key}"
+        )
+    return lines, (1 if lines else 0)
+
+
+def _human(report_dict: dict, out) -> None:
+    s = report_dict["summary"]
+    print(f"graftcheck: graph {s['graph']!r}", file=out)
+    print("  step  live-hbm  est-bytes  node", file=out)
+    for row in report_dict["liveness"]:
+        mark = " *" if row["node"] == s["hbm_high_water_node"] else ""
+        print(f"  {row['step']:>4}  {len(row['live_hbm']):>8}  "
+              f"{row['hbm_bytes_est']:>9}  {row['node']}{mark}", file=out)
+    print(f"  hbm high-water ~{s['hbm_high_water_bytes_est']} bytes "
+          f"at {s['hbm_high_water_node']}", file=out)
+    don = report_dict["donation_eligible"]
+    for node in sorted(don):
+        print(f"  donation-eligible at {node}: {', '.join(don[node])}",
+              file=out)
+    for f in report_dict["findings"]:
+        print(f"  {f['severity']}: {f['kind']}: {f['message']}", file=out)
+    print(f"graftcheck: {s['verdict']} ({s['violations']} violation(s), "
+          f"{s['advisories']} advisory(ies))", file=out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftcheck",
+        description="semantic analysis of the production stage graph "
+                    "(see tools/graftcheck/__init__.py)",
+    )
+    ap.add_argument("--config", help="run-config JSON (default: a "
+                                     "default-constructed production config)")
+    ap.add_argument("--n-reads", type=int, default=10_000,
+                    help="workload size feeding the byte model")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--expect", nargs="?", const=DEFAULT_EXPECT,
+                    help="compare findings against an expected list "
+                         "(default: the committed production list); "
+                         "drift in either direction fails")
+    ap.add_argument("--write-expect",
+                    help="write the current findings as the expected list")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as exc:  # argparse exits 2 on usage errors
+        return int(exc.code or 0)
+
+    try:
+        from ont_tcrconsensus_tpu.graph import check as check_mod
+
+        if args.config is not None and not os.path.exists(args.config):
+            print(f"graftcheck: no such config: {args.config}",
+                  file=sys.stderr)
+            return 2
+        cfg, spec, problems = _build_spec(args.config)
+        if spec is None:
+            for p in problems:
+                print(f"  violation: graph-invalid: {p}")
+            print(f"graftcheck: violations ({len(problems)} violation(s), "
+                  "0 advisory(ies))")
+            return 1
+        report = check_mod.analyze(
+            spec, check_mod.production_byte_model(cfg, n_reads=args.n_reads))
+        body = report.to_dict()
+
+        rc = 1 if report.violations else 0
+        expect_lines: list[str] = []
+        if args.expect:
+            expect_lines, expect_rc = _compare_expected(
+                body["findings"], args.expect)
+            rc = max(rc, expect_rc)
+        if args.write_expect:
+            with open(args.write_expect, "w", encoding="utf-8") as fh:
+                json.dump({"graph": report.graph,
+                           "findings": body["findings"]}, fh, indent=2)
+                fh.write("\n")
+
+        if args.as_json:
+            body["expect"] = expect_lines
+            body["exit_code"] = rc
+            print(json.dumps(body, indent=2))
+        else:
+            _human(body, sys.stdout)
+            for line in expect_lines:
+                print(line, file=sys.stderr)
+        return rc
+    except Exception as exc:  # never-crash contract: no tracebacks
+        print(f"graftcheck: internal error: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+        return 2

@@ -143,6 +143,39 @@ def run_paths(paths: list[str]) -> list[Finding]:
     return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
 
 
+def _baseline_key(entry: dict) -> tuple:
+    """Identity of a known finding: location-insensitive (line/col drift
+    from unrelated edits must not churn the baseline), message-sensitive
+    (a rule firing differently IS a new finding)."""
+    return (entry.get("path", ""), entry.get("rule", ""),
+            entry.get("message", ""))
+
+
+def load_baseline(path: str) -> set[tuple]:
+    """Known-finding keys from a ``--write-baseline`` file.  Each entry
+    may carry a free-form ``justification`` the tool ignores."""
+    with open(path, encoding="utf-8") as fh:
+        body = json.load(fh)
+    entries = body.get("findings", []) if isinstance(body, dict) else body
+    return {_baseline_key(e) for e in entries if isinstance(e, dict)}
+
+
+def apply_baseline(findings: list[Finding], known: set[tuple],
+                   ) -> tuple[list[Finding], list[Finding], set[tuple]]:
+    """(new, baselined, stale-keys) split of ``findings`` vs the baseline."""
+    new: list[Finding] = []
+    old: list[Finding] = []
+    seen: set[tuple] = set()
+    for f in findings:
+        key = _baseline_key(f.to_dict())
+        if key in known:
+            old.append(f)
+            seen.add(key)
+        else:
+            new.append(f)
+    return new, old, known - seen
+
+
 def main(argv: list[str] | None = None) -> int:
     import argparse
 
@@ -157,6 +190,13 @@ def main(argv: list[str] | None = None) -> int:
                     help="machine-readable output")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalogue and exit")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="known-findings file: findings matching an entry "
+                    "(by path+rule+message; lines may drift) are reported "
+                    "but do not fail the run — only NEW findings exit 1")
+    ap.add_argument("--write-baseline", metavar="FILE",
+                    help="write the current findings as a baseline file "
+                    "(add a justification: to each entry before committing)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -173,14 +213,47 @@ def main(argv: list[str] | None = None) -> int:
             return 2
 
     findings = run_paths(args.paths)
+
+    if args.write_baseline:
+        with open(args.write_baseline, "w", encoding="utf-8") as fh:
+            json.dump({"findings": [
+                {**f.to_dict(), "justification": ""} for f in findings
+            ]}, fh, indent=2)
+            fh.write("\n")
+        print(f"graftlint: wrote {len(findings)} finding(s) to "
+              f"{args.write_baseline}", file=sys.stderr)
+        return 0
+
+    baselined: list[Finding] = []
+    stale: set[tuple] = set()
+    if args.baseline:
+        try:
+            known = load_baseline(args.baseline)
+        except (OSError, ValueError) as exc:
+            print(f"graftlint: cannot read baseline {args.baseline}: {exc}",
+                  file=sys.stderr)
+            return 2
+        findings, baselined, stale = apply_baseline(findings, known)
+
     if args.as_json:
         print(json.dumps({
             "findings": [f.to_dict() for f in findings],
             "count": len(findings),
+            "baselined": [f.to_dict() for f in baselined],
+            "stale_baseline": [
+                {"path": p, "rule": r, "message": m}
+                for p, r, m in sorted(stale)
+            ],
         }, indent=2))
     else:
         for finding in findings:
             print(finding.format())
+        for finding in baselined:
+            print(f"{finding.format()} [baselined]")
+        for p, r, m in sorted(stale):
+            print(f"graftlint: stale baseline entry (fixed? remove it): "
+                  f"{p}: {r} {m}", file=sys.stderr)
         if findings:
-            print(f"graftlint: {len(findings)} finding(s)", file=sys.stderr)
+            print(f"graftlint: {len(findings)} new finding(s)",
+                  file=sys.stderr)
     return 1 if findings else 0

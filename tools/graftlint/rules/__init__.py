@@ -5,15 +5,19 @@ from __future__ import annotations
 from tools.graftlint.rules import (
     chaos_sites,
     config_fields,
+    donation_use,
     exception_guard,
     graph_sites,
     imports,
     jit_hygiene,
+    lock_discipline,
     obs_sites,
+    recompile_hazard,
 )
 
 _MODULES = (jit_hygiene, exception_guard, chaos_sites, obs_sites,
-            graph_sites, config_fields, imports)
+            graph_sites, config_fields, imports, donation_use,
+            recompile_hazard, lock_discipline)
 
 CHECKS = tuple(m.check for m in _MODULES)
 

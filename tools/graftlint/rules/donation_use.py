@@ -1,0 +1,184 @@
+"""donation-use-after-donate: a donated buffer referenced after the call.
+
+``jax.jit(fn, donate_argnums=...)`` hands the argument's device buffer to
+XLA for reuse: after the call dispatches, the caller's array aliases
+freed (or overwritten) memory, and touching it raises a
+``RuntimeError: invalid buffer`` — but only on backends that honor
+donation, so the bug ships silently from CPU dev boxes.  The rule tracks
+every binding of a donating jit in a file —
+
+- ``f = jax.jit(fn, donate_argnums=(0,))`` assignments,
+- ``@partial(jax.jit, donate_argnums=...)`` / ``@jax.jit(...)``
+  decorated defs,
+- immediate ``jax.jit(fn, donate_argnums=(0,))(x)`` calls —
+
+and then walks each scope (module body, every function body) in
+statement order: a Name passed in a donated position is poisoned from
+the statement after the call until it is rebound or deleted; any load of
+a poisoned name is a finding.  Scope-local and syntactic by design —
+donation through containers or across files is out of reach, but the
+pattern the rule targets (donate, then log/assert/reuse the input) is
+exactly the one SNIPPETS-class production stacks ban.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.astutil import ImportMap, dotted_name
+from tools.graftlint.core import FileCtx, Finding, Project
+
+RULES = {
+    "donation-use-after-donate": "argument passed under donate_argnums "
+                                 "referenced after the call (its device "
+                                 "buffer has been handed to XLA)",
+}
+
+_JIT_TARGETS = {"jax.jit", "jax.api.jit"}
+_PARTIAL_TARGETS = {"functools.partial", "partial"}
+
+
+def _donate_positions(call: ast.Call) -> tuple[int, ...]:
+    """donate_argnums literal positions from a jax.jit(...) call."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        out = []
+        for node in ast.walk(kw.value):
+            if isinstance(node, ast.Constant) and isinstance(node.value, int):
+                out.append(node.value)
+        return tuple(sorted(set(out)))
+    return ()
+
+
+def _is_jit(node: ast.AST, imports: ImportMap) -> bool:
+    return (imports.resolve_call_target(node) in _JIT_TARGETS
+            or dotted_name(node) in _JIT_TARGETS)
+
+
+def _donating_jit_call(node: ast.AST, imports: ImportMap,
+                       ) -> tuple[int, ...] | None:
+    """donate positions when ``node`` is a jax.jit/partial(jax.jit) call
+    carrying donate_argnums; None otherwise."""
+    if not isinstance(node, ast.Call):
+        return None
+    if _is_jit(node.func, imports):
+        pos = _donate_positions(node)
+        return pos or None
+    target = imports.resolve_call_target(node.func)
+    if target in _PARTIAL_TARGETS and node.args and _is_jit(node.args[0],
+                                                           imports):
+        pos = _donate_positions(node)
+        return pos or None
+    return None
+
+
+def _donating_bindings(ctx: FileCtx, imports: ImportMap) -> dict[str, tuple]:
+    """{name: donated positions} for every donating binding in the file."""
+    out: dict[str, tuple] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign):
+            pos = _donating_jit_call(node.value, imports)
+            if pos:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = pos
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                pos = _donating_jit_call(deco, imports)
+                if pos:
+                    out[node.name] = pos
+    return out
+
+
+def _scopes(tree: ast.Module):
+    """(body, label) for the module and every function, innermost last."""
+    yield tree.body, "<module>"
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.body, node.name
+
+
+class _ScopeWalker:
+    """Statement-order walk of one scope body with a poisoned-name set."""
+
+    def __init__(self, ctx: FileCtx, imports: ImportMap,
+                 bindings: dict[str, tuple]):
+        self.ctx = ctx
+        self.imports = imports
+        self.bindings = bindings
+        # name -> (call line, callee label)
+        self.poisoned: dict[str, tuple[int, str]] = {}
+        self.findings: list[Finding] = []
+
+    def _donations_in(self, stmt: ast.stmt) -> list[tuple[str, int, str]]:
+        """(arg name, line, callee) per donated Name argument in ``stmt``."""
+        out = []
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            pos: tuple[int, ...] | None = None
+            label = None
+            if isinstance(node.func, ast.Name):
+                pos = self.bindings.get(node.func.id)
+                label = node.func.id
+            if pos is None:
+                pos = _donating_jit_call(node.func, self.imports)
+                label = "jax.jit(...)"
+            if not pos:
+                continue
+            for p in pos:
+                if p < len(node.args) and isinstance(node.args[p], ast.Name):
+                    out.append((node.args[p].id, node.lineno, label))
+        return out
+
+    def _check_loads(self, stmt: ast.stmt) -> None:
+        for node in ast.walk(stmt):
+            if (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in self.poisoned):
+                line, callee = self.poisoned[node.id]
+                self.findings.append(Finding(
+                    self.ctx.path, node.lineno, node.col_offset,
+                    "donation-use-after-donate",
+                    f"`{node.id}` was donated to `{callee}` on line {line}; "
+                    "its device buffer belongs to XLA now — reorder the "
+                    "use before the call or drop donate_argnums",
+                ))
+
+    def _clear_stores(self, stmt: ast.stmt) -> None:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, (ast.Store, ast.Del)):
+                self.poisoned.pop(node.id, None)
+
+    def walk(self, body: list[ast.stmt]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # own scope; walked separately by _scopes
+            # whole-statement granularity: loads are checked against the
+            # poison set from PRIOR statements, so `x = f(x)` (donate and
+            # rebind in one statement) stays clean; a donation and a use
+            # inside the same compound statement is conservatively missed
+            self._check_loads(stmt)
+            for name, line, callee in self._donations_in(stmt):
+                self.poisoned[name] = (line, callee)
+            self._clear_stores(stmt)
+
+
+def check(project: Project) -> Iterator[Finding]:
+    for ctx in project.files:
+        imports = ImportMap(ctx.tree)
+        bindings = _donating_bindings(ctx, imports)
+        has_inline = any(
+            _donating_jit_call(n.func, imports)
+            for n in ast.walk(ctx.tree) if isinstance(n, ast.Call)
+        )
+        if not bindings and not has_inline:
+            continue
+        for body, _label in _scopes(ctx.tree):
+            walker = _ScopeWalker(ctx, imports, bindings)
+            walker.walk(body)
+            yield from walker.findings

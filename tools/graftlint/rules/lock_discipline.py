@@ -1,0 +1,183 @@
+"""lock-discipline: shared registry state mutated outside its lock.
+
+A lightweight static race detector for the shared-mutable layers the
+telemetry / watchdog / overlap work built: the obs metrics registry is
+fed from worker threads and the watchdog monitor thread, the watchdog's
+entry table from every guarded stage, the overlap executor's counters
+from pool workers — each guards its state with one lock, and a mutation
+that skips it is a data race that only loses increments under load,
+never in a unit test.
+
+The ownership table is declarative and lives NEXT TO the class it
+protects: a ``LOCK_OWNERSHIP = {"ClassName.attr": "lock_attr"}`` dict
+literal anywhere in the scanned tree (obs/metrics.py, robustness/
+watchdog.py, pipeline/overlap.py ship one each); fixture trees declare
+their own, and with none in scope the rule no-ops — the same
+registry-in-the-scanned-set discipline as the chaos/obs/graph site
+rules.
+
+Within a listed class, any *mutation* of ``self.<attr>`` — rebinding,
+augmented assignment, subscript store/delete, or a mutating method call
+(``.append``/``.update``/``.setdefault``/...) — must sit lexically
+inside ``with self.<lock_attr>:``.  Reads are exempt (the registries
+tolerate torn reads for display), as are ``__init__`` (no concurrent
+access before construction completes) and methods named ``*_locked``
+(the caller-holds-the-lock convention, e.g. IngestGuard._close_locked).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.core import FileCtx, Finding, Project
+
+RULES = {
+    "lock-discipline": "registry attribute mutated outside its declared "
+                       "lock (LOCK_OWNERSHIP table) — a data race under "
+                       "worker/monitor threads",
+}
+
+_TABLE_NAME = "LOCK_OWNERSHIP"
+_MUTATING_METHODS = {
+    "append", "add", "update", "setdefault", "pop", "popitem", "clear",
+    "remove", "discard", "extend", "insert", "__setitem__",
+}
+
+
+def ownership(project: Project) -> dict[str, dict[str, str]]:
+    """{class: {attr: lock_attr}} merged from every LOCK_OWNERSHIP dict
+    literal in the scanned files."""
+    table: dict[str, dict[str, str]] = {}
+    for ctx in project.files:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == _TABLE_NAME
+                for t in node.targets
+            ) and isinstance(node.value, ast.Dict)):
+                continue
+            for k, v in zip(node.value.keys, node.value.values):
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)
+                        and "." in k.value):
+                    continue
+                cls, attr = k.value.rsplit(".", 1)
+                table.setdefault(cls, {})[attr] = v.value
+    return table
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """'attr' when ``node`` is ``self.attr`` (possibly under subscripts)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _MethodWalker:
+    """Walk one method body tracking which self.<lock> blocks enclose."""
+
+    def __init__(self, ctx: FileCtx, cls: str, method: str,
+                 owned: dict[str, str]):
+        self.ctx = ctx
+        self.cls = cls
+        self.method = method
+        self.owned = owned
+        self.held: set[str] = set()
+        self.findings: list[Finding] = []
+
+    def _flag(self, node: ast.AST, attr: str, how: str) -> None:
+        lock = self.owned[attr]
+        self.findings.append(Finding(
+            self.ctx.path, node.lineno, node.col_offset, "lock-discipline",
+            f"{self.cls}.{self.method} {how} self.{attr} outside "
+            f"`with self.{lock}:` — worker/monitor threads race this "
+            "registry",
+        ))
+
+    def _check_mutation(self, node: ast.AST, attr: str | None,
+                        how: str) -> None:
+        if attr is None or attr not in self.owned:
+            return
+        if self.owned[attr] not in self.held:
+            self._flag(node, attr, how)
+
+    def walk(self, body: list[ast.stmt]) -> None:
+        for stmt in body:
+            self.visit(stmt)
+
+    def visit(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def may run on another thread later: it must take
+            # the lock itself, so the held set does not flow in
+            saved, self.held = self.held, set()
+            self.walk(stmt.body)
+            self.held = saved
+            return
+        if isinstance(stmt, ast.With):
+            added = set()
+            for item in stmt.items:
+                lock = _self_attr(item.context_expr)
+                if lock is not None and lock not in self.held:
+                    self.held.add(lock)
+                    added.add(lock)
+            self.walk(stmt.body)
+            self.held -= added
+            return
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for target in targets:
+                self._check_mutation(stmt, _self_attr(target), "writes")
+        if isinstance(stmt, ast.Delete):
+            for target in stmt.targets:
+                self._check_mutation(stmt, _self_attr(target), "deletes")
+        self._scan_calls(stmt)
+        for field in ("body", "orelse", "finalbody"):
+            for sub in getattr(stmt, field, ()):
+                self.visit(sub)
+        for handler in getattr(stmt, "handlers", ()):
+            for sub in handler.body:
+                self.visit(sub)
+
+    def _scan_calls(self, node: ast.AST) -> None:
+        """Mutating method calls in THIS statement's own expressions —
+        nested statements are visited by visit() under their own held
+        set, and a Lambda body runs later (possibly off-thread), so both
+        are boundaries, not children."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.stmt, ast.excepthandler, ast.Lambda)):
+                continue
+            if (isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Attribute)
+                    and child.func.attr in _MUTATING_METHODS):
+                self._check_mutation(
+                    child, _self_attr(child.func.value),
+                    f"calls .{child.func.attr}() on")
+            self._scan_calls(child)
+
+
+def check(project: Project) -> Iterator[Finding]:
+    table = ownership(project)
+    if not table:
+        return
+    for ctx in project.files:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.ClassDef) and node.name in table):
+                continue
+            owned = table[node.name]
+            for method in node.body:
+                if not isinstance(method, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                    continue
+                if (method.name == "__init__"
+                        or method.name.endswith("_locked")):
+                    continue
+                walker = _MethodWalker(ctx, node.name, method.name, owned)
+                walker.walk(method.body)
+                yield from walker.findings

@@ -1,0 +1,193 @@
+"""recompile-hazard: data-dependent Python sizes reaching shape sinks.
+
+The static twin of the runtime recompile audit (obs/device.py's
+``jax.monitoring`` listener): that one *counts* XLA compiles after the
+fact; this rule flags the source pattern that causes them.  A value
+derived from ``len(...)`` is data-dependent — every distinct input size
+that reaches a shape-determining argument compiles a fresh program, the
+exact storm the bucketing helpers exist to prevent.
+
+Taint: names assigned from expressions containing a ``len(...)`` call
+(propagated through arithmetic, ``min``/``max``, f-strings — anything),
+per scope, in statement order.  An expression is *sanitized* — clean no
+matter what it contains — when it passes through a quantizer: a
+``pow2_ceil(...)`` / ``bucket_width(...)`` call, or any reference to the
+fixed ``DEFAULT_WIDTHS`` table (``next(w for w in DEFAULT_WIDTHS if
+w >= need)`` is the sanctioned snap-to-bucket idiom).
+
+Sinks: a tainted ``pad_to=`` keyword in any call (the repo's one shape
+knob — ops/encode.pad_batch and friends), and a tainted shape argument
+(first positional or ``shape=``) of a ``jax.numpy`` array constructor.
+Host ``np.zeros`` stays exempt: host allocation is free to vary.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.astutil import ImportMap, dotted_name
+from tools.graftlint.core import FileCtx, Finding, Project
+
+RULES = {
+    "recompile-hazard": "len()-derived Python size reaches a shape "
+                        "argument (pad_to= / jnp constructor) without a "
+                        "bucketing quantizer — one XLA compile per "
+                        "distinct input size",
+}
+
+# calls whose result is quantized (safe to hand to a shape sink)
+_QUANTIZERS = {"pow2_ceil", "bucket_width"}
+# fixed bucket tables: expressions selecting from them are quantized
+_QUANT_TABLES = {"DEFAULT_WIDTHS"}
+# jax.numpy constructors whose leading/shape argument compiles the shape
+_JNP_SHAPE_CALLS = {"zeros", "ones", "full", "empty", "arange"}
+
+
+def _basename(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class _Taint:
+    """Per-scope taint oracle over an evolving name set."""
+
+    def __init__(self):
+        self.names: set[str] = set()
+
+    def sanitized(self, expr: ast.AST) -> bool:
+        for node in ast.walk(expr):
+            if (isinstance(node, ast.Call)
+                    and _basename(node.func) in _QUANTIZERS):
+                return True
+            if _basename(node) in _QUANT_TABLES:
+                return True
+        return False
+
+    def tainted(self, expr: ast.AST) -> bool:
+        if self.sanitized(expr):
+            return False
+        for node in ast.walk(expr):
+            if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                    and node.func.id == "len"):
+                return True
+            if (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)
+                    and node.id in self.names):
+                return True
+        return False
+
+    def assign(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            if value is None:
+                return
+            taint = self.tainted(value)
+            if isinstance(stmt, ast.AugAssign):
+                taint = taint or self.tainted(stmt.target)
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for target in targets:
+                for node in ast.walk(target):
+                    if isinstance(node, ast.Name):
+                        if taint:
+                            self.names.add(node.id)
+                        else:
+                            self.names.discard(node.id)
+
+
+def _jnp_shape_call(node: ast.Call, imports: ImportMap) -> bool:
+    target = (imports.resolve_call_target(node.func)
+              or dotted_name(node.func) or "")
+    return (target.startswith(("jax.numpy.", "jnp."))
+            and _basename(node.func) in _JNP_SHAPE_CALLS)
+
+
+def _scope_bodies(tree: ast.Module):
+    yield tree.body
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.body
+
+
+def _walk_scope(ctx: FileCtx, imports: ImportMap, body: list[ast.stmt],
+                ) -> Iterator[Finding]:
+    """Linear statement-order walk (loop bodies visited once, so
+    loop-carried taint is conservatively missed)."""
+    taint = _Taint()
+    findings: list[Finding] = []
+
+    def check_call(node: ast.Call) -> None:
+        for kw in node.keywords:
+            if kw.arg == "pad_to" and taint.tainted(kw.value):
+                findings.append(Finding(
+                    ctx.path, node.lineno, node.col_offset,
+                    "recompile-hazard",
+                    "pad_to= receives a len()-derived size; snap it "
+                    "to a bucket first (pow2_ceil / bucket_width / "
+                    "DEFAULT_WIDTHS) or every distinct input size "
+                    "compiles a new program",
+                ))
+        if _jnp_shape_call(node, imports):
+            shape_args = list(node.args[:1]) + [
+                kw.value for kw in node.keywords if kw.arg == "shape"
+            ]
+            for arg in shape_args:
+                if taint.tainted(arg):
+                    findings.append(Finding(
+                        ctx.path, node.lineno, node.col_offset,
+                        "recompile-hazard",
+                        f"jnp.{_basename(node.func)} shape is "
+                        "len()-derived; bucket it or the constructor "
+                        "recompiles per distinct size",
+                    ))
+
+    def scan_exprs(node: ast.AST) -> None:
+        """Sink-check this statement's own expressions, stopping at
+        nested statements / defs (visited in order by visit())."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.stmt, ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(child, ast.Call):
+                check_call(child)
+            scan_exprs(child)
+
+    def visit(stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # own scope
+        scan_exprs(stmt)
+        taint.assign(stmt)
+        branches = [list(getattr(stmt, field, ()))
+                    for field in ("body", "orelse", "finalbody")]
+        branches += [h.body for h in getattr(stmt, "handlers", ())]
+        branches = [b for b in branches if b]
+        if not branches:
+            return
+        # alternative branches (if/else, try/except) each run from the
+        # pre-branch state; afterwards a name is tainted when ANY path
+        # taints it (base included: a branch may not execute at all)
+        base = set(taint.names)
+        merged: set[str] = set()
+        for branch in branches:
+            taint.names = set(base)
+            for sub in branch:
+                visit(sub)
+            merged |= taint.names
+        taint.names = base | merged
+
+    for stmt in body:
+        visit(stmt)
+    yield from findings
+
+
+def check(project: Project) -> Iterator[Finding]:
+    for ctx in project.files:
+        if "pad_to" not in ctx.source and "jnp." not in ctx.source:
+            continue  # cheap skip: no sinks possible
+        imports = ImportMap(ctx.tree)
+        for body in _scope_bodies(ctx.tree):
+            yield from _walk_scope(ctx, imports, body)
