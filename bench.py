@@ -119,19 +119,22 @@ def build_dataset(root: str, seed: int = 33):
     return lib
 
 
-def run_once(root: str):
+def run_once(root: str, live_port: int | None = None):
     from ont_tcrconsensus_tpu.pipeline.config import RunConfig
     from ont_tcrconsensus_tpu.pipeline.run import run_with_config
 
     shutil.rmtree(os.path.join(root, "fastq_pass", "nano_tcr"), ignore_errors=True)
-    cfg = RunConfig.from_dict({
+    raw = {
         "reference_file": os.path.join(root, "reference.fa"),
         "fastq_pass_dir": os.path.join(root, "fastq_pass"),
         "minimal_length": 1000,
         "min_reads_per_cluster": 4,
         "read_batch_size": 1024,
         "delete_tmp_files": False,
-    })
+    }
+    if live_port is not None:
+        raw["live_port"] = live_port
+    cfg = RunConfig.from_dict(raw)
     t0 = time.time()
     results = run_with_config(cfg)
     dt = time.time() - t0
@@ -243,6 +246,12 @@ def parse_args(argv=None):
         "fingerprint/backend/n_reads entries) and exit 1 on regression; "
         "the capture is appended to the ledger either way",
     )
+    ap.add_argument(
+        "--live-port", type=int, default=None, metavar="PORT",
+        help="arm the live observability plane (obs/live.py) for the bench "
+        "runs: /healthz, /metrics, /progress on 127.0.0.1:PORT (0 = "
+        "ephemeral) — lets an operator watch a long TPU capture mid-flight",
+    )
     ap.add_argument("--gate-threshold", type=float, default=0.15)
     ap.add_argument("--gate-mad-k", type=float, default=4.0)
     ap.add_argument("--gate-min-samples", type=int, default=3)
@@ -305,8 +314,8 @@ def main(argv=None) -> int:
 
     # warm-up run compiles every kernel; timed run measures steady state
     try:
-        _, warm_dt, _ = run_once(root)
-        results, dt, cfg = run_once(root)
+        _, warm_dt, _ = run_once(root, live_port=args.live_port)
+        results, dt, cfg = run_once(root, live_port=args.live_port)
     except Exception as exc:  # backend died mid-run: still record a JSON line
         import traceback
 

@@ -35,6 +35,7 @@ import time
 from typing import Any
 
 from ont_tcrconsensus_tpu.graph.ir import GraphSpec, Node
+from ont_tcrconsensus_tpu.obs import live as obs_live
 from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
 from ont_tcrconsensus_tpu.robustness import faults, retry, watchdog
 
@@ -92,6 +93,10 @@ class GraphExecutor:
             obs_metrics.graph_node_declare(
                 node.name, inputs=node.inputs, outputs=node.outputs)
 
+        # live /progress denominator: every scheduled node, before any
+        # skip accounting, so done/total is stable across resume paths
+        obs_live.progress_plan([n.name for n in spec.schedule])
+
         skip, resume_node = self._resume_scan()
         values = dict(inputs)
         refs: dict[str, int] = {}
@@ -104,12 +109,14 @@ class GraphExecutor:
         for node in spec.schedule:
             if node.name in skip:
                 obs_metrics.graph_node_skip(node.name)
+                obs_live.progress_node_skip(node.name)
                 continue
             if node is resume_node:
                 # reload crossing edges from disk instead of running
                 values.update(node.resume_reload(ctx) if node.resume_reload
                               else {})
                 obs_metrics.graph_node_skip(node.name)
+                obs_live.progress_node_skip(node.name)
                 continue
             node_inputs = {e: values[e] for e in node.inputs}
             units = node.eval_units(ctx, node_inputs)
@@ -154,6 +161,7 @@ class GraphExecutor:
     def _run_node(self, node: Node, inputs: dict, units: int) -> dict:
         ctx = self.ctx
         t0 = time.monotonic()
+        obs_live.progress_node_start(node.name, units=units)
         try:
             with ctx.timer.stage(node.name), \
                     watchdog.guard(node.name, units=units):
@@ -162,8 +170,9 @@ class GraphExecutor:
                 if node.commit is not None:
                     node.commit(ctx, outputs)
         finally:
-            obs_metrics.graph_node_add(
-                node.name, critical_s=time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            obs_metrics.graph_node_add(node.name, critical_s=dt)
+            obs_live.progress_node_finish(node.name, dt, units=units)
         return outputs
 
     def _commit_pending(self, values: dict, refs: dict[str, int]) -> None:
@@ -196,6 +205,7 @@ class GraphExecutor:
             obs_metrics.graph_node_add(
                 node.name, critical_s=time.monotonic() - t0,
                 overlapped_s=deferred.worker_seconds)
+            obs_live.progress_node_finish(node.name, deferred.worker_seconds)
             _log(f"graph: {node.name} computed off the critical path "
                  f"({deferred.worker_seconds:.1f}s overlapped)")
             self._absorb(node, outputs, values, refs)

@@ -85,6 +85,12 @@ OBS_SITES = frozenset({
     # --- memory high-water gauges (metrics.gauge_max, device sampler) ---
     "device.hbm_bytes_in_use",
     "host.rss_bytes",
+    # --- live observability plane (obs/live.py: endpoint request counter
+    # via metrics.counter_add, flight-recorder instants via
+    # live.ring_event) ---
+    "live.requests",
+    "live.serve",
+    "flight.flush",
 })
 
 KNOWN_SITES = OBS_SITES

@@ -55,6 +55,10 @@ FINGERPRINT_EXCLUDED_KEYS = frozenset({
     "nanopore_tcr_seq_primers_fasta",
     "profile_trace_dir",
     "history_ledger",
+    # observation endpoints, not workload knobs: a live-armed run must
+    # share a baseline pool (and /progress ETA priors) with a live-off
+    # run of the same workload
+    "live_port",
 })
 
 #: MAD -> sigma-equivalent scale for normally-distributed noise
@@ -157,6 +161,26 @@ def build_entry(source: str, telemetry: dict | None = None, *,
         analysis = telemetry.get("analysis") or {}
         if isinstance(analysis, dict) and "graftcheck" in analysis:
             entry["graftcheck"] = analysis["graftcheck"]
+        # executed-graph per-node seconds (additive): the stage roll-up
+        # above loses the executor's critical/overlapped attribution, so
+        # the critical-path analyzer and the live plane's /progress ETA
+        # priors (obs/live.load_node_priors) would otherwise disagree on
+        # what a node costs. Seconds/units are summed over the run's
+        # libraries; `runs` lets readers recover per-execution pace.
+        graph = telemetry.get("graph")
+        gnodes = graph.get("nodes") if isinstance(graph, dict) else None
+        if isinstance(gnodes, dict):
+            nodes = {}
+            for name, g in gnodes.items():
+                if isinstance(g, dict) and g.get("runs"):
+                    nodes[name] = {
+                        "s": g.get("critical_s", 0.0),
+                        "overlapped_s": g.get("overlapped_s", 0.0),
+                        "runs": g.get("runs", 1),
+                        "units": g.get("units", 0),
+                    }
+            if nodes:
+                entry["nodes"] = nodes
     if extra:
         entry.update(extra)
     return entry

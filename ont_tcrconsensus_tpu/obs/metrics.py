@@ -249,6 +249,114 @@ class MetricsRegistry:
                 out["overlap_pool"] = pool
             return out
 
+    def prometheus_lines(self) -> list[str]:
+        """Prometheus text-exposition (v0.0.4) rendering of the registry,
+        served live by obs/live.py's /metrics route.
+
+        One locked pass over the same aggregates ``summary()`` rolls up;
+        site/stage/node names become label values (dots and all — label
+        VALUES are free-form, only metric names are constrained), so the
+        exposition vocabulary is exactly :data:`~ont_tcrconsensus_tpu.obs.
+        OBS_SITES` and no scrape-side mapping table can drift.
+        """
+        def fam(lines: list[str], name: str, kind: str, help_: str,
+                samples: list[tuple[str, str, float]]) -> None:
+            if not samples:
+                return
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} {kind}")
+            for label, value, v in samples:
+                lines.append(f'{name}{{{label}="{prom_label(value)}"}} {v:g}')
+
+        with self._lock:
+            lines: list[str] = [
+                "# HELP tcr_run_duration_seconds Seconds since the "
+                "registry was armed.",
+                "# TYPE tcr_run_duration_seconds gauge",
+                f"tcr_run_duration_seconds "
+                f"{time.monotonic() - self.t0_mono:g}",
+            ]
+            fam(lines, "tcr_counter_total", "counter",
+                "Hot-loop counters (metrics.counter_add sites).",
+                [("site", k, self.counters[k])
+                 for k in sorted(self.counters)])
+            fam(lines, "tcr_gauge", "gauge",
+                "High-water gauges (HBM in use, host RSS, ...).",
+                [("site", k, self.gauges[k]) for k in sorted(self.gauges)])
+            for i, (suffix, help_) in enumerate((
+                ("count", "Histogram observation counts."),
+                ("sum", "Histogram observation sums."),
+                ("min", "Histogram observation minima."),
+                ("max", "Histogram observation maxima."),
+            )):
+                fam(lines, f"tcr_observations_{suffix}",
+                    "counter" if i < 2 else "gauge", help_,
+                    [("site", k, self.hists[k][i])
+                     for k in sorted(self.hists)])
+            fam(lines, "tcr_stage_seconds_total", "counter",
+                "Per-stage span seconds (same clock as stage_timing.tsv).",
+                [("stage", k, self.stages[k][0])
+                 for k in sorted(self.stages)])
+            fam(lines, "tcr_stage_calls_total", "counter",
+                "Per-stage span entry counts.",
+                [("stage", k, self.stages[k][1])
+                 for k in sorted(self.stages)])
+            disp = sorted(self.dispatch)
+            fam(lines, "tcr_dispatch_total", "counter",
+                "Per-site device dispatch counts.",
+                [("site", k, self.dispatch[k][0]) for k in disp])
+            fam(lines, "tcr_dispatch_gets_total", "counter",
+                "Per-site blocking-get counts.",
+                [("site", k, self.dispatch[k][1]) for k in disp])
+            fam(lines, "tcr_dispatch_host_seconds_total", "counter",
+                "Per-site host-gap seconds (dispatch tax).",
+                [("site", k, self.dispatch[k][2]) for k in disp])
+            fam(lines, "tcr_dispatch_block_seconds_total", "counter",
+                "Per-site blocked-on-device seconds.",
+                [("site", k, self.dispatch[k][3]) for k in disp])
+            fam(lines, "tcr_xla_compiles_total", "counter",
+                "XLA backend compiles per stage[shape-bucket].",
+                [("stage", k, self.compiles[k][0])
+                 for k in sorted(self.compiles)])
+            fam(lines, "tcr_xla_compile_seconds_total", "counter",
+                "XLA backend compile seconds per stage[shape-bucket].",
+                [("stage", k, self.compiles[k][1])
+                 for k in sorted(self.compiles)])
+            pools = sorted(self.pools)
+            fam(lines, "tcr_pool_busy_seconds_total", "counter",
+                "Worker-pool busy seconds.",
+                [("site", k, self.pools[k][0]) for k in pools])
+            fam(lines, "tcr_pool_idle_seconds_total", "counter",
+                "Worker-pool idle seconds.",
+                [("site", k, self.pools[k][1]) for k in pools])
+            fam(lines, "tcr_pool_window_seconds_total", "counter",
+                "Worker-pool measurement-window seconds.",
+                [("site", k, self.pools[k][2]) for k in pools])
+            fam(lines, "tcr_pool_slots", "gauge",
+                "Worker-pool slot count.",
+                [("site", k, self.pools[k][3]) for k in pools])
+            gnodes = sorted(self.graph_nodes)
+            fam(lines, "tcr_graph_node_critical_seconds_total", "counter",
+                "Per-node critical-path seconds.",
+                [("node", k, self.graph_nodes[k][0]) for k in gnodes])
+            fam(lines, "tcr_graph_node_overlapped_seconds_total", "counter",
+                "Per-node overlapped worker seconds.",
+                [("node", k, self.graph_nodes[k][1]) for k in gnodes])
+            fam(lines, "tcr_graph_node_runs_total", "counter",
+                "Per-node execution counts.",
+                [("node", k, self.graph_nodes[k][2]) for k in gnodes])
+            fam(lines, "tcr_graph_node_skips_total", "counter",
+                "Per-node resume-skip counts.",
+                [("node", k, self.graph_nodes[k][3]) for k in gnodes])
+            return lines
+
+
+def prom_label(value: str) -> str:
+    """Escape a Prometheus label VALUE (exposition format: backslash,
+    double quote and newline must be escaped inside the quotes)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
 
 # Lock-ownership declaration for graftlint's lock-discipline rule: every
 # mutation of these registries outside `with self._lock:` is a data race

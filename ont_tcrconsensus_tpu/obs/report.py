@@ -157,6 +157,29 @@ def _render_telemetry(data: dict, lines: list[str]) -> None:
         lines.append("robustness events: none")
 
 
+def _render_flight_recorder(base: str, rec: dict, lines: list[str]) -> None:
+    """Human rendering of one ``logs/flight_recorder*.json`` flush
+    (obs/live.py). Raises on a valid-JSON-but-garbage payload — callers
+    degrade that to a named problem, matching the telemetry readers."""
+    if rec.get("schema") != 1:
+        raise ValueError(f"unsupported flight-recorder schema "
+                         f"{rec.get('schema')!r}")
+    events = rec["events"]
+    dropped = int(rec.get("dropped", 0))
+    lines.append(
+        f"flight recorder {base}: flushed on {rec['reason']!r}, "
+        f"{len(events)} buffered event(s)"
+        + (f", {dropped} older dropped" if dropped else "")
+    )
+    for ev in events[-10:]:
+        args = ev.get("args")
+        lines.append(
+            f"  [{ev['kind']:9s}] {ev['name']} "
+            f"t+{float(ev['t_s']):.3f}s ({ev.get('thread', '?')})"
+            + (f" {args}" if args else "")
+        )
+
+
 def render_report(nano_dir: str, critical_path: bool = False) -> tuple[str, int]:
     """(report text, exit code) from the committed artifacts in
     ``nano_dir``. Exit 1 when no telemetry artifact exists. With
@@ -239,6 +262,25 @@ def render_report(nano_dir: str, critical_path: bool = False) -> tuple[str, int]
             f"{os.path.basename(rpath)}: {n_events} event(s), "
             f"chaos {'armed' if chaos else 'off'}"
         )
+    for fpath in sorted(glob.glob(
+        os.path.join(nano_dir, "logs", "flight_recorder*.json")
+    )):
+        base = os.path.basename(fpath)
+        try:
+            with open(fpath) as fh:
+                rec = json.load(fh)
+            if not isinstance(rec, dict):
+                raise ValueError("not a JSON object")
+        except (OSError, ValueError) as exc:
+            lines.append(f"unreadable flight recorder {base}: {exc!r}")
+            rc = 1
+            continue
+        try:
+            _render_flight_recorder(base, rec, lines)
+        except Exception as exc:
+            # same never-crash contract as the telemetry readers above
+            lines.append(f"malformed flight recorder {base}: {exc!r}")
+            rc = 1
     tsvs = sorted(glob.glob(
         os.path.join(nano_dir, "*", "logs", "stage_timing.tsv")
     ))
@@ -327,6 +369,29 @@ def collect_report(nano_dir: str, critical_path: bool = False
         except (OSError, ValueError, AttributeError, TypeError):
             robustness[base] = {"problem": "unreadable"}
     out["robustness_reports"] = robustness
+    flights: dict = {}
+    for fpath in sorted(glob.glob(
+        os.path.join(nano_dir, "logs", "flight_recorder*.json")
+    )):
+        base = os.path.basename(fpath)
+        try:
+            with open(fpath) as fh:
+                rec = json.load(fh)
+            if not isinstance(rec, dict):
+                raise ValueError("not a JSON object")
+            _render_flight_recorder(base, rec, [])  # schema check only
+        except (OSError, ValueError) as exc:
+            out["problems"].append(f"unreadable flight recorder {base}: "
+                                   f"{exc!r}")
+            rc = 1
+            continue
+        except Exception as exc:
+            out["problems"].append(f"malformed flight recorder {base}: "
+                                   f"{exc!r}")
+            rc = 1
+            continue
+        flights[base] = rec
+    out["flight_recorders"] = flights
     out["stage_timing_tsvs"] = len(glob.glob(
         os.path.join(nano_dir, "*", "logs", "stage_timing.tsv")))
     hist: dict = {}

@@ -80,6 +80,9 @@ class Span:
         col = _ARMED
         if col is not None:
             col.add_span(self)
+        ring = _RING
+        if ring is not None:
+            ring.add_span(self)
         return False
 
 
@@ -93,6 +96,9 @@ def instant(name: str, args: dict | None = None) -> None:
     col = _ARMED
     if col is not None:
         col.add_instant(name, args)
+    ring = _RING
+    if ring is not None:
+        ring.add_instant(name, args)
 
 
 #: in-memory event cap: a multi-hour ``telemetry: full`` run (sampler
@@ -206,3 +212,18 @@ def disarm() -> None:
 
 def collector() -> TraceCollector | None:
     return _ARMED
+
+
+# --- flight-recorder tap (obs/live.py) --------------------------------------
+#
+# A SECOND slot, deliberately distinct from the full collector: the live
+# plane's bounded ring is armed by ``live_port`` (not ``telemetry: full``),
+# so spans and instants reach the crash flight recorder even on runs where
+# the unbounded trace collector stays off. Same one-attr-check discipline.
+
+_RING = None
+
+
+def set_ring(ring) -> None:
+    global _RING
+    _RING = ring

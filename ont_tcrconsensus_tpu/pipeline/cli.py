@@ -78,6 +78,15 @@ def main(argv: list[str] | None = None) -> int:
         "per-node dispatch tax, overlap-pool efficiency.",
     )
     parser.add_argument(
+        "--live-port", type=int, default=None, metavar="PORT",
+        help="Arm the live observability plane for this run (overrides the "
+        "live_port config knob): read-only /healthz, /metrics (Prometheus "
+        "text) and /progress (JSON with ETA) on 127.0.0.1:PORT, plus the "
+        "crash flight recorder (nano_tcr/logs/flight_recorder.json; "
+        "flushed on crash, SIGTERM drain, watchdog hard expiry, or "
+        "SIGUSR1). 0 binds an ephemeral port.",
+    )
+    parser.add_argument(
         "--validate", action="store_true",
         help="Dry-run input validation: parse the config, scan every input "
         "file (record counts/sizes via the tolerant parser — no device "
@@ -94,6 +103,10 @@ def main(argv: list[str] | None = None) -> int:
         parser.error("--json is a --report/--validate option")
     if args.critical_path and not args.report:
         parser.error("--critical-path is a --report option")
+    if args.live_port is not None and (args.report or args.validate):
+        parser.error("--live-port is a run option (it arms a live endpoint "
+                     "for the run's duration; --report/--validate exit "
+                     "immediately)")
 
     if args.report:
         # never touches jax: safe on hosts with a wedged device tunnel
@@ -127,7 +140,7 @@ def main(argv: list[str] | None = None) -> int:
     from ont_tcrconsensus_tpu.robustness import shutdown
 
     try:
-        run_pipeline(args.json_config_file)
+        run_pipeline(args.json_config_file, live_port=args.live_port)
     except shutdown.Preempted as p:
         # preemption-safe exit: committed checkpoints are intact; 143 is
         # the conventional SIGTERM status so orchestrators reschedule

@@ -160,6 +160,20 @@ class RunConfig:
     #   stage spans per thread + instant events for every robustness
     #   occurrence) and runs the periodic HBM/RSS sampler. Render with
     #   `tcr-consensus-tpu --report <workdir>`
+    live_port: int | None = None  # live observability plane (obs/live.py):
+    #   when set, the run serves read-only GET endpoints on
+    #   127.0.0.1:<live_port> for its duration — /healthz (liveness +
+    #   watchdog heartbeat-staleness verdict), /metrics (Prometheus text
+    #   exposition of the armed registry + live per-stage heartbeat ages)
+    #   and /progress (current library/node, nodes done/total, ETA from
+    #   history-ledger priors) — and arms the crash flight recorder (a
+    #   bounded span/robustness/heartbeat ring flushed atomically to
+    #   nano_tcr/logs/flight_recorder.json on crash, SIGTERM drain,
+    #   watchdog hard expiry, or SIGUSR1). 0 binds an OS-chosen ephemeral
+    #   port (tests). null (default) disarms the whole plane: the planted
+    #   sites are one module-attr check and nothing ever listens. Binds
+    #   loopback only and serves no mutating route; excluded from the
+    #   config fingerprint (observation, not workload)
     history_ledger: str | None = None  # opt-in CROSS-run ledger path (e.g.
     #   a repo-level BENCH_HISTORY.jsonl): every telemetry-armed run
     #   appends its history entry there in addition to the per-run
@@ -367,6 +381,15 @@ class RunConfig:
         if self.telemetry not in ("off", "on", "full"):
             raise ValueError(
                 f"telemetry={self.telemetry!r} not in ('off', 'on', 'full')"
+            )
+        if self.live_port is not None and (
+            not isinstance(self.live_port, int)
+            or isinstance(self.live_port, bool)
+            or not (0 <= self.live_port <= 65535)
+        ):
+            raise ValueError(
+                f"live_port={self.live_port!r} must be an int in [0, 65535] "
+                "(0 = ephemeral) or null (null = live plane disarmed)"
             )
         if self.history_ledger is not None and (
             not isinstance(self.history_ledger, str) or not self.history_ledger

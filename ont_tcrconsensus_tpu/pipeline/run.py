@@ -23,6 +23,7 @@ Ray fan-out (tcr_consensus.py:141-167; SURVEY §2.3).
 
 from __future__ import annotations
 
+import dataclasses
 import faulthandler
 import glob
 import json
@@ -40,6 +41,7 @@ from ont_tcrconsensus_tpu.io import bucketing, fastx, layout
 from ont_tcrconsensus_tpu.io import validate as validate_mod
 from ont_tcrconsensus_tpu.obs import device as obs_device
 from ont_tcrconsensus_tpu.obs import history as obs_history
+from ont_tcrconsensus_tpu.obs import live as obs_live
 from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
 from ont_tcrconsensus_tpu.obs import report as obs_report
 from ont_tcrconsensus_tpu.obs import trace as obs_trace
@@ -80,9 +82,17 @@ def enable_compilation_cache(cache_dir: str | None = None) -> None:
         _log(f"compilation cache unavailable: {exc!r}")
 
 
-def run_pipeline(config_path: str, polisher=None) -> dict[str, dict[str, int]]:
-    """Run the full pipeline; returns {library: {region: count}}."""
+def run_pipeline(config_path: str, polisher=None,
+                 live_port: int | None = None) -> dict[str, dict[str, int]]:
+    """Run the full pipeline; returns {library: {region: count}}.
+
+    ``live_port`` (the ``--live-port`` CLI flag) overrides the config's
+    ``live_port`` knob — an operator can arm the live plane on a one-off
+    run without editing the committed config."""
     cfg = RunConfig.from_json(config_path)
+    if live_port is not None:
+        cfg = dataclasses.replace(cfg, live_port=live_port)
+        cfg.validate()
     return run_with_config(cfg, polisher=polisher)
 
 
@@ -233,6 +243,7 @@ def _run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]
     # module-attribute check.
     sampler = None
     sigquit_log = _SigquitRunLog()
+    live_usr1 = obs_live.Sigusr1Hook()
     try:
         if cfg.telemetry != "off":
             obs_metrics.arm()
@@ -240,8 +251,31 @@ def _run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]
             if cfg.telemetry == "full":
                 obs_trace.arm()
                 sampler = obs_device.start_sampler()
-        return _run_with_config_body(cfg, polisher, sigquit_log)
+        # The live plane arms independently of the telemetry level: its
+        # flight ring is the post-mortem context for runs where the full
+        # trace collector is NOT armed, and /metrics stays a valid (if
+        # sparse) exposition even at telemetry=off.
+        if cfg.live_port is not None:
+            srv = obs_live.arm(cfg.live_port)
+            live_usr1.install()
+            _log(f"Live observability plane on http://127.0.0.1:{srv.port} "
+                 "(/healthz /metrics /progress; SIGUSR1 flushes the "
+                 "flight recorder)")
+        try:
+            return _run_with_config_body(cfg, polisher, sigquit_log)
+        except BaseException as exc:
+            # the flight recorder's whole reason to exist: flush the last
+            # N events while the process still can. Preempted (a SIGTERM/
+            # SIGINT drain) and KeyboardInterrupt are BaseExceptions, so
+            # Exception alone would miss exactly the deaths that matter.
+            obs_live.flush_armed(
+                "sigterm_drain" if isinstance(exc, shutdown.Preempted)
+                else f"crash:{type(exc).__name__}"
+            )
+            raise
     finally:
+        live_usr1.restore()
+        obs_live.disarm()
         if sampler is not None:
             sampler.stop()
         obs_trace.disarm()
@@ -330,6 +364,13 @@ def _run_with_config_body(
     # diagnosable post-hoc from the output tree, even when stderr was lost.
     # The wrapper's finally restores the pre-run disposition on every exit.
     sigquit_log.register(nano_dir, proc_id)
+    # crash/SIGUSR1 flight-recorder flushes land inside the output tree
+    # (next to the watchdog/SIGQUIT logs); no-op when the plane is disarmed
+    obs_live.set_flush_path(os.path.join(
+        nano_dir, "logs",
+        "flight_recorder.json" if n_proc == 1
+        else f"flight_recorder_p{proc_id}.json",
+    ))
 
     # PHASE A: reference self-homology (tcr_consensus.py:90-105)
     _log("Mapping reference self homology")
@@ -404,6 +445,15 @@ def _run_with_config_body(
         # DCN (parallel/distributed.py); chips within the host shard batches
         fastq_list = dist.shard_libraries(fastq_list)
         _log(f"Process {proc_id}/{n_proc} owns {len(fastq_list)} libraries")
+    # /progress denominators + ETA priors: per-node seconds from the run's
+    # own ledger and the cross-run one, filtered to this config fingerprint
+    # (the ledger I/O only happens when the plane is armed)
+    obs_live.progress_totals(len(fastq_list))
+    obs_live.configure_eta_priors(
+        [os.path.join(nano_dir, obs_history.HISTORY_BASENAME)]
+        + ([cfg.history_ledger] if cfg.history_ledger else []),
+        obs_history.config_fingerprint(cfg),
+    )
 
     results: dict[str, dict[str, int]] = {}
     failed_libraries: list[tuple[str, str]] = []
@@ -418,6 +468,7 @@ def _run_with_config_body(
     try:
         for fastq in fastq_list:
             shutdown.checkpoint("run.library_start")
+            obs_live.progress_library(layout.library_name_from_fastq(fastq))
             # The whole per-library unit is guarded (dir init and resume
             # reload included): a failed library degrades to a report
             # instead of aborting the run — and, multi-host, instead of
@@ -446,6 +497,10 @@ def _run_with_config_body(
                 library = layout.library_name_from_fastq(fastq)
                 failed_libraries.append((library, repr(exc)))
                 _log(f"WARNING: library {library} failed and is skipped: {exc!r}")
+            finally:
+                # a failed library still advances /progress: the ETA is
+                # about remaining work, not about success
+                obs_live.progress_library_done()
     except shutdown.Preempted as p:
         preempted = p
         _log(f"PREEMPTED: {p}; every committed stage checkpoint is "

@@ -206,6 +206,25 @@ class Watchdog:
         entry = self._entries.get(threading.get_ident())
         return entry.hard_s if entry is not None else None
 
+    def entries_snapshot(self) -> list[dict]:
+        """Per-stage heartbeat ages for the live plane (/healthz verdict,
+        /metrics gauges): one locked pass, age measured against a single
+        clock read so the staleness comparison is self-consistent."""
+        now = time.monotonic()
+        with self._lock:
+            return [
+                {
+                    "stage": e.name,
+                    "thread": e.thread_name,
+                    "heartbeat_age_s": round(now - e.last_beat, 3),
+                    "soft_deadline_s": round(e.soft_s, 3),
+                    "hard_deadline_s": round(e.hard_s, 3),
+                    "last_heartbeat_site": e.last_site,
+                    "soft_fired": e.soft_fired,
+                }
+                for e in self._entries.values()
+            ]
+
     # --- monitor ------------------------------------------------------------
 
     def start(self) -> None:
@@ -305,6 +324,16 @@ class Watchdog:
             f"({stalled:.1f}s > {entry.hard_s:.1f}s); cancelled "
             f"(StageTimeout -> the transient retry/degrade path)"
         )
+        # hard expiry is a likely prelude to a dead run: flush the flight
+        # recorder NOW (obs/live.py sink) while the process still can.
+        # Best-effort — a flush failure must never kill the monitor.
+        sink = _EXPIRY_SINK
+        if sink is not None:
+            try:
+                sink(entry.name)
+            except Exception as exc:
+                sys.stderr.write(
+                    f"watchdog: expiry sink failed: {exc!r}\n")
 
 
 # Lock-ownership declaration for graftlint's lock-discipline rule: the
@@ -338,10 +367,16 @@ def active() -> bool:
 
 def heartbeat(site: str) -> None:
     """Reset the calling thread's stage stall clock; free no-op when the
-    watchdog is disarmed or the thread holds no guard."""
+    watchdog is disarmed or the thread holds no guard. Independently, a
+    live-plane beat sink (obs/live.py flight recorder) sees every beat —
+    heartbeats are progress evidence worth keeping post-mortem even on
+    runs where the watchdog itself is disarmed."""
     wd = _ACTIVE
     if wd is not None:
         wd.beat(site)
+    sink = _BEAT_SINK
+    if sink is not None:
+        sink(site)
 
 
 def guard(name: str, units: int = 0):
@@ -364,3 +399,29 @@ def set_log_path(path: str | os.PathLike[str]) -> None:
     wd = _ACTIVE
     if wd is not None:
         wd.log_path = os.fspath(path)
+
+
+def snapshot() -> list[dict] | None:
+    """Per-stage heartbeat ages (None when the watchdog is disarmed) —
+    the live plane's /healthz staleness verdict and /metrics gauges."""
+    wd = _ACTIVE
+    return wd.entries_snapshot() if wd is not None else None
+
+
+# --- live-plane sinks (obs/live.py; same one-attr-check discipline) ---------
+
+_BEAT_SINK = None
+_EXPIRY_SINK = None
+
+
+def set_beat_sink(sink) -> None:
+    """Install/remove a callable(site) fed every heartbeat (flight ring)."""
+    global _BEAT_SINK
+    _BEAT_SINK = sink
+
+
+def set_expiry_sink(sink) -> None:
+    """Install/remove a callable(stage) fired after a hard-deadline
+    cancel (the flight recorder's crash-prelude flush trigger)."""
+    global _EXPIRY_SINK
+    _EXPIRY_SINK = sink

@@ -15,7 +15,10 @@
 # explicitly in CI output instead of drowning in the full run; pass
 # --no-chaos to skip it. Then: a telemetry smoke (tiny run at
 # telemetry=full — artifacts exist + validate, pipeline outputs
-# byte-identical to telemetry=off), a graph-executor smoke (tiny workload
+# byte-identical to telemetry=off), a live-observability smoke (tiny run
+# with live_port armed — /healthz /metrics /progress served mid-run,
+# SIGUSR1 flushes the flight recorder, outputs byte-identical to a
+# live-off run), a graph-executor smoke (tiny workload
 # under executor=graph vs imperative — counts CSV + consensus FASTA
 # byte-identical, telemetry attributed per node), a perf-gate smoke (two
 # tiny runs feed a shared run-history ledger; scripts/perf_gate.py stays
@@ -119,6 +122,18 @@ trc=$?
 if [ "$trc" -ne 0 ]; then
     echo "telemetry smoke FAILED (rc=$trc)" >&2
     exit "$trc"
+fi
+
+echo "--- live observability smoke (tiny run with live_port armed: /healthz"
+echo "    /metrics /progress fetched MID-RUN and valid, SIGUSR1 flushes a"
+echo "    schema-valid flight recorder, counts/consensus byte-identical to"
+echo "    a live-off run) ---"
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/test_live.py -q \
+    -k "live_e2e" -p no:cacheprovider -p no:xdist -p no:randomly
+vrc=$?
+if [ "$vrc" -ne 0 ]; then
+    echo "live observability smoke FAILED (rc=$vrc)" >&2
+    exit "$vrc"
 fi
 
 echo "--- graph executor smoke (tiny workload under executor=graph vs"

@@ -10,6 +10,7 @@ the default band width.
 """
 
 import numpy as np
+import pytest
 
 from ont_tcrconsensus_tpu.cluster import regions
 from ont_tcrconsensus_tpu.io import fastx, simulator
@@ -119,6 +120,8 @@ def test_asymmetric_softclip_budgets_fixed_physical_windows():
     assert (out_w["d3"][valid] == 0).all(), out_w["d3"][valid]
 
 
+@pytest.mark.slow  # ~25s: full targeted-vs-fused agreement sweep; the
+# non-slow band tests cover the same window math on smaller inputs
 def test_targeted_pass_agrees_with_fused_pass():
     """Given the fused pass's own chosen ref as the single candidate, the
     round-2 targeted pass must reproduce its assignment exactly (ridx,

@@ -89,6 +89,9 @@ def _run(reads, panel, fast_denom):
     )
 
 
+@pytest.mark.slow  # ~40s: the heaviest fast-vs-exact equivalence sweep;
+# the non-slow tier keeps the cheaper done-mask/error-profile and cosine
+# separation checks over the same engine
 def test_fast_vs_exact_same_survivors_and_outputs():
     lib = _library(seed=91)
     panel = _panel(lib)

@@ -645,17 +645,26 @@ def test_graph_run_attributes_telemetry_per_node(graph_lib):
     assert set(g["nodes"]) == set(GRAPH_NODES)
     for name, row in g["nodes"].items():
         assert row["runs"] == 1 and row["skips"] == 0, name
+    # proof an overlapped node ran off the critical path is its `<name>_bg`
+    # worker-thread span reaching the TSV (only the DeferredStage worker
+    # emits one), not its overlapped_s magnitude: that is wall time rounded
+    # to 1ms, and write_region_fastas can legitimately finish under that on
+    # a fast box. Only the slower QC profiles must show nonzero worker
+    # seconds. (critical_s for these nodes is the commit-barrier wait —
+    # small but not necessarily zero.)
+    tsv = (graph_lib["baseline_nano"] / "barcode01" / "logs" /
+           "stage_timing.tsv").read_text()
     for overlapped in ("round1_error_profile", "write_region_fastas",
                       "round2_error_profile"):
-        assert g["nodes"][overlapped]["overlapped_s"] > 0, overlapped
+        assert g["nodes"][overlapped]["overlapped_s"] >= 0, overlapped
+        assert f"{overlapped}_bg\t" in tsv, overlapped
+    for profiled in ("round1_error_profile", "round2_error_profile"):
+        assert g["nodes"][profiled]["overlapped_s"] > 0, profiled
     assert g["nodes"]["round1_polish"]["overlapped_s"] == 0
     assert g["edges"]["read_store"] == "hbm"
     assert g["edges"]["counts_csv"] == "disk"
     # the per-node spans feed the same stage table + TSV as before
-    tsv = (graph_lib["baseline_nano"] / "barcode01" / "logs" /
-           "stage_timing.tsv").read_text()
     assert "round1_polish\t" in tsv
-    assert "write_region_fastas_bg\t" in tsv  # the worker's overlapped row
 
 
 def test_graph_vs_imperative_byte_identity(graph_lib, tmp_path):

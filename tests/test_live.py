@@ -1,0 +1,609 @@
+"""Live observability plane (obs/live.py): /healthz /metrics /progress,
+the crash flight recorder, ETA priors, and the disarmed-overhead contract.
+
+The e2e pair (live_port=0 vs live off on the same tiny library) is also
+the tier-1 live smoke (scripts/tier1.sh): all three endpoints must serve
+valid payloads MID-RUN — probed from inside a gated graph node — the
+SIGUSR1 flush must land a schema-valid flight_recorder.json, and the
+pipeline outputs must stay byte-identical: the live plane observes the
+run, it must never change it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ont_tcrconsensus_tpu.obs import history as obs_history
+from ont_tcrconsensus_tpu.obs import live as obs_live
+from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
+from ont_tcrconsensus_tpu.obs import report as obs_report
+from ont_tcrconsensus_tpu.obs import trace as obs_trace
+from ont_tcrconsensus_tpu.robustness import watchdog
+
+# Prometheus text exposition 0.0.4: every sample line is
+# name{labels} value — families are announced by # HELP / # TYPE
+_PROM_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r" [0-9eE+.\-]+$"
+)
+
+
+def validate_prometheus(text: str) -> dict[str, int]:
+    """Parse an exposition; returns {family sample prefix: sample count}."""
+    families: dict[str, int] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _PROM_SAMPLE.match(line), f"bad exposition line: {line!r}"
+        name = line.split("{", 1)[0].split(" ", 1)[0]
+        families[name] = families.get(name, 0) + 1
+    return families
+
+
+# ---------------------------------------------------------------------------
+# flight recorder ring
+
+
+def test_flight_ring_bounds_drops_and_atomic_flush(tmp_path):
+    ring = obs_live.FlightRecorder(max_events=4)
+    assert ring.flush("early") is None  # no path yet: nowhere to write
+    for i in range(6):
+        ring.add_instant(f"ev{i}")
+    stats = ring.stats()
+    assert stats["buffered"] == 4 and stats["total"] == 6
+    assert stats["dropped"] == 2 and stats["last_flush"] is None
+    path = tmp_path / "logs" / "flight_recorder.json"
+    ring.set_flush_path(str(path))
+    assert ring.flush("test_reason") == str(path)
+    assert not path.with_suffix(".json.tmp").exists()
+    rec = json.loads(path.read_text())
+    assert rec["schema"] == obs_live.FLIGHT_SCHEMA
+    assert rec["reason"] == "test_reason" and rec["pid"] == os.getpid()
+    assert rec["dropped"] == 2
+    assert [e["name"] for e in rec["events"]] == ["ev2", "ev3", "ev4", "ev5"]
+    assert all(e["kind"] == "instant" and e["t_s"] >= 0.0 and e["thread"]
+               for e in rec["events"])
+    assert ring.stats()["last_flush"]["reason"] == "test_reason"
+
+
+def test_flight_ring_event_kinds():
+    ring = obs_live.FlightRecorder()
+    with obs_trace.span("round1_polish") as sp:
+        pass
+    ring.add_span(sp)
+    ring.add_instant("chaos.inject", args={"kind": "transient"})
+    ring.add_beat("polish.chunk")
+    kinds = [(e["kind"], e["name"]) for e in ring.events]
+    assert kinds == [("span", "round1_polish"), ("instant", "chaos.inject"),
+                     ("heartbeat", "polish.chunk")]
+    (span_ev, inst_ev, _) = list(ring.events)
+    assert span_ev["dur_s"] >= 0.0 and inst_ev["args"] == {"kind": "transient"}
+
+
+# ---------------------------------------------------------------------------
+# progress tracker + ETA
+
+
+def test_progress_eta_from_priors_and_measured_override():
+    tr = obs_live.ProgressTracker()
+    snap = tr.snapshot()
+    assert snap["eta_s"] is None and snap["eta_basis"] is None
+    tr.set_totals(2)
+    tr.set_priors({"a": {"s": 10.0, "units": 0},
+                   "b": {"s": 20.0, "units": 0}})
+    tr.start_library("barcode01")
+    tr.set_plan(["a", "b"])
+    snap = tr.snapshot()
+    # this library (10+20) + 1 more full library (libs_left excludes the
+    # in-flight one): 30 + 30
+    assert snap["eta_basis"] == "history_priors"
+    assert snap["eta_s"] == pytest.approx(60.0, abs=1.0)
+    assert snap["library"] == "barcode01" and snap["nodes_total"] == 2
+    # measured pace overrides the prior for later estimates
+    tr.node_start("a")
+    tr.node_finish("a", 5.0)
+    snap = tr.snapshot()
+    # remaining b=20, next library a(measured 5)+b(20)=25
+    assert snap["eta_s"] == pytest.approx(45.0, abs=1.0)
+    assert snap["nodes_done"] == 1
+    tr.node_finish("b", 21.0)
+    tr.finish_library()
+    assert tr.snapshot()["libraries_done"] == 1
+
+
+def test_progress_eta_measured_pace_and_units_rescale():
+    tr = obs_live.ProgressTracker()
+    tr.set_totals(1)
+    tr.start_library("l")
+    tr.set_plan(["a", "b"])
+    tr.node_start("a")
+    tr.node_finish("a", 8.0)
+    snap = tr.snapshot()
+    # no priors: basis falls back to this run's own pace; b is unmeasured
+    # so it gets the mean of known estimates (8.0)
+    assert snap["eta_basis"] == "measured_pace"
+    assert snap["eta_s"] == pytest.approx(8.0, abs=1.0)
+    # units rescale applies to the IN-FLIGHT node only: a prior measured
+    # at 100 units predicts 2x the seconds at 200 units
+    tr2 = obs_live.ProgressTracker()
+    tr2.set_totals(1)
+    tr2.set_priors({"a": {"s": 10.0, "units": 100}})
+    tr2.start_library("l")
+    tr2.set_plan(["a"])
+    tr2.node_start("a", units=200)
+    snap = tr2.snapshot()
+    assert snap["node"] == "a" and snap["node_units"] == 200
+    assert snap["eta_s"] == pytest.approx(20.0, abs=1.0)
+
+
+def test_progress_in_flight_node_elapsed_is_subtracted_and_clamped():
+    tr = obs_live.ProgressTracker()
+    tr.set_totals(1)
+    tr.set_priors({"a": {"s": 0.05, "units": 0}})
+    tr.start_library("l")
+    tr.set_plan(["a"])
+    tr.node_start("a")
+    time.sleep(0.12)  # elapsed > prior: the node estimate clamps at 0
+    snap = tr.snapshot()
+    assert snap["eta_s"] == pytest.approx(0.0, abs=0.02)
+    assert snap["node_elapsed_s"] >= 0.1
+
+
+def test_load_node_priors_fingerprint_filter_runs_division_median(tmp_path):
+    ledger = tmp_path / "history.jsonl"
+    entries = [
+        # 3 runs summed: per-execution sample is 30/3=10s, 9/3=3 units
+        {"schema": 1, "fingerprint": "fp1",
+         "nodes": {"n": {"s": 30.0, "runs": 3, "units": 9}}},
+        {"schema": 1, "fingerprint": "fp1",
+         "nodes": {"n": {"s": 14.0, "runs": 1, "units": 5}}},
+        # wrong fingerprint: a differently-sized workload never pollutes
+        {"schema": 1, "fingerprint": "fp2",
+         "nodes": {"n": {"s": 9000.0, "runs": 1, "units": 1}}},
+        # garbage shapes are skipped, never raise
+        {"schema": 1, "fingerprint": "fp1", "nodes": "nope"},
+        {"schema": 1, "fingerprint": "fp1",
+         "nodes": {"n": {"s": True, "runs": 1}, "m": "x"}},
+    ]
+    with open(ledger, "w") as fh:
+        for e in entries:
+            fh.write(json.dumps(e) + "\n")
+        fh.write("not json\n")
+    priors = obs_live.load_node_priors(
+        [str(ledger), str(tmp_path / "missing.jsonl")], "fp1")
+    assert priors["n"]["s"] == pytest.approx(12.0)   # median(10, 14)
+    assert priors["n"]["units"] == pytest.approx(4.0)  # median(3, 5)
+    assert obs_live.load_node_priors([str(ledger)], "fp-none") == {}
+
+
+# ---------------------------------------------------------------------------
+# /metrics rendering
+
+
+def test_metrics_text_is_valid_exposition_and_covers_registry():
+    reg = obs_metrics.arm()
+    try:
+        obs_metrics.counter_add("assign.batches", 3)
+        obs_metrics.gauge_max("host.rss_bytes", 12345.0)
+        obs_metrics.observe("polish.chunk_clusters", 7)
+        reg.stage_add("round1_polish", 0.25)
+        obs_metrics.pool_add("overlap.pool", busy_s=1.0, idle_s=0.5,
+                             window_s=1.5, slots=2)
+        obs_metrics.graph_node_add("round1_polish", critical_s=0.25)
+        text = obs_live._metrics_text()
+    finally:
+        obs_metrics.disarm()
+    fams = validate_prometheus(text)
+    assert fams["tcr_up"] == 1
+    assert fams["tcr_counter_total"] >= 1
+    assert fams["tcr_gauge"] >= 1
+    assert fams["tcr_observations_count"] >= 1
+    assert fams["tcr_stage_seconds_total"] >= 1
+    assert fams["tcr_pool_busy_seconds_total"] >= 1
+    assert fams["tcr_graph_node_critical_seconds_total"] >= 1
+    assert 'tcr_counter_total{site="assign.batches"} 3' in text
+    # disarmed registry: still a valid, non-empty exposition
+    fams_off = validate_prometheus(obs_live._metrics_text())
+    assert fams_off == {"tcr_up": 1}
+
+
+def test_prom_label_escaping():
+    assert obs_metrics.prom_label('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+    text = (
+        f'tcr_counter_total{{site="{obs_metrics.prom_label(chr(10))}"}} 1'
+    )
+    assert _PROM_SAMPLE.match(text)
+
+
+# ---------------------------------------------------------------------------
+# /healthz verdict
+
+
+def test_healthz_stalled_verdict_from_watchdog_heartbeat_age():
+    payload = obs_live._healthz_payload()
+    assert payload["status"] == "ok" and not payload["watchdog"]["armed"]
+    wd = watchdog.Watchdog(base_timeout_s=0.2)  # monitor NOT started:
+    watchdog.activate(wd)                        # verdict math only
+    try:
+        with wd.guard("round1_polish"):
+            watchdog.heartbeat("polish.chunk")
+            fresh = obs_live._healthz_payload()
+            assert fresh["status"] == "ok"
+            (entry,) = fresh["watchdog"]["stages"]
+            assert entry["stage"] == "round1_polish"
+            assert entry["last_heartbeat_site"] == "polish.chunk"
+            time.sleep(0.15)  # past the soft deadline without a beat
+            stale = obs_live._healthz_payload()
+            assert stale["status"] == "stalled"
+            assert stale["watchdog"]["stalled_stages"] == ["round1_polish"]
+    finally:
+        watchdog.deactivate(wd)
+    assert obs_live._healthz_payload()["status"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# disarmed overhead: the one-module-attr-check contract
+
+
+def test_disarmed_live_sites_touch_nothing():
+    """Disarmed (the default), every planted live site must reduce to one
+    module-attr check: a method-less sentinel in the slot blows up the
+    moment any call path touches it, and with the slot at None every call
+    is a silent no-op (the test_obs sentinel pattern)."""
+    assert obs_live._RING is None and obs_live._PROGRESS is None
+    assert obs_trace._RING is None
+    obs_live.ring_event("flight.flush", {"reason": "x"})
+    obs_live.set_flush_path("/nowhere")
+    assert obs_live.flush_armed("crash:Nope") is None
+    obs_live.progress_totals(3)
+    obs_live.progress_library("barcode01")
+    obs_live.progress_plan(["round1_polish"])
+    obs_live.progress_node_start("round1_polish", units=4)
+    obs_live.progress_node_finish("round1_polish", 1.0)
+    obs_live.progress_node_skip("round1_polish")
+    obs_live.progress_library_done()
+    obs_live.configure_eta_priors(["/nowhere.jsonl"], "fp")  # and no I/O
+    sentinel = object()
+    obs_live._RING = sentinel
+    try:
+        with pytest.raises(AttributeError):
+            obs_live.ring_event("flight.flush")
+    finally:
+        obs_live._RING = None
+    obs_live._PROGRESS = sentinel
+    try:
+        with pytest.raises(AttributeError):
+            obs_live.progress_node_start("round1_polish")
+    finally:
+        obs_live._PROGRESS = None
+    obs_trace._RING = sentinel
+    try:
+        with pytest.raises(AttributeError):
+            with obs_trace.span("round1_polish"):
+                pass
+    finally:
+        obs_trace._RING = None
+
+
+def test_watchdog_sinks_disarmed_are_one_attr_check():
+    assert watchdog._BEAT_SINK is None and watchdog._EXPIRY_SINK is None
+    watchdog.heartbeat("polish.chunk")  # no guard, no sink: silent no-op
+    seen: list[str] = []
+    watchdog.set_beat_sink(seen.append)
+    try:
+        # the sink sees every beat even with the watchdog itself disarmed
+        watchdog.heartbeat("assign.batch")
+    finally:
+        watchdog.set_beat_sink(None)
+    assert seen == ["assign.batch"]
+
+
+def test_config_live_port_validation():
+    from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+
+    base = {"reference_file": "r.fa", "fastq_pass_dir": "fq"}
+    assert RunConfig.from_dict(base).live_port is None
+    assert RunConfig.from_dict({**base, "live_port": 0}).live_port == 0
+    for bad in (-1, 65536, True, "8080"):
+        with pytest.raises(ValueError, match="live_port"):
+            RunConfig.from_dict({**base, "live_port": bad})
+
+
+def test_sigusr1_hook_flushes_and_restores(tmp_path):
+    ring = obs_live.FlightRecorder()
+    ring.add_instant("chaos.inject")
+    path = tmp_path / "flight_recorder.json"
+    ring.set_flush_path(str(path))
+    obs_live._RING = ring
+    hook = obs_live.Sigusr1Hook()
+    prev = signal.getsignal(signal.SIGUSR1)
+    hook.install()
+    try:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        deadline = time.monotonic() + 5.0
+        while not path.exists() and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        hook.restore()
+        obs_live._RING = None
+    assert json.loads(path.read_text())["reason"] == "sigusr1"
+    assert signal.getsignal(signal.SIGUSR1) == prev
+
+
+# ---------------------------------------------------------------------------
+# --report flight-recorder tail (satellite: obs/report.py)
+
+
+def _write_minimal_run(wd):
+    wd.mkdir(parents=True, exist_ok=True)
+    (wd / "telemetry.json").write_text(json.dumps({"telemetry": "on"}))
+
+
+def test_report_renders_flight_recorder_tail(tmp_path, capsys):
+    wd = tmp_path / "nano_tcr"
+    _write_minimal_run(wd)
+    (wd / "logs").mkdir()
+    rec = {
+        "schema": 1, "reason": "sigusr1", "t_wall": 1.0, "t0_wall": 0.0,
+        "t0_mono": 0.0, "pid": 7, "dropped": 3,
+        "events": [{"kind": "span", "name": "round1_polish", "t_s": 1.25,
+                    "dur_s": 0.5, "thread": "MainThread"},
+                   {"kind": "heartbeat", "name": "polish.chunk",
+                    "t_s": 1.5, "thread": "MainThread"}],
+    }
+    (wd / "logs" / "flight_recorder.json").write_text(json.dumps(rec))
+    assert obs_report.report_main(str(wd)) == 0
+    out = capsys.readouterr().out
+    assert "flight recorder flight_recorder.json: flushed on 'sigusr1'" in out
+    assert "2 buffered event(s), 3 older dropped" in out
+    assert "round1_polish" in out and "polish.chunk" in out
+    data, rc = obs_report.collect_report(str(wd))
+    assert rc == 0
+    assert data["flight_recorders"]["flight_recorder.json"]["reason"] == \
+        "sigusr1"
+
+
+def test_report_degrades_on_flight_recorder_garbage(tmp_path, capsys):
+    """Never-crash contract: valid-JSON-garbage flight recorders become
+    named problems + exit 1, on both the text and --json paths."""
+    wd = tmp_path / "nano_tcr"
+    _write_minimal_run(wd)
+    (wd / "logs").mkdir()
+    (wd / "logs" / "flight_recorder.json").write_text(
+        '{"schema": 1, "reason": "crash"}')  # events missing
+    (wd / "logs" / "flight_recorder_p1.json").write_text('["not", "object"]')
+    (wd / "logs" / "flight_recorder_p2.json").write_text("{torn")
+    assert obs_report.report_main(str(wd)) == 1
+    out = capsys.readouterr().out
+    assert "malformed flight recorder flight_recorder.json" in out
+    assert "unreadable flight recorder flight_recorder_p1.json" in out
+    assert "unreadable flight recorder flight_recorder_p2.json" in out
+    data, rc = obs_report.collect_report(str(wd))
+    assert rc == 1 and data["flight_recorders"] == {}
+    assert len([p for p in data["problems"] if "flight recorder" in p]) == 3
+
+
+# ---------------------------------------------------------------------------
+# e2e: endpoints probed mid-run, SIGUSR1 flush, byte-identity vs live-off
+
+
+@pytest.fixture(scope="module")
+def live_library(tmp_path_factory):
+    from ont_tcrconsensus_tpu.io import fastx, simulator
+
+    tmp = tmp_path_factory.mktemp("live_e2e")
+    lib = simulator.simulate_library(
+        seed=23,
+        num_regions=3,
+        molecules_per_region=(2, 3),
+        reads_per_molecule=(5, 7),
+        sub_rate=0.006,
+        ins_rate=0.003,
+        del_rate=0.003,
+        region_len=(700, 850),
+    )
+    fastx.write_fasta(tmp / "reference.fa", lib.reference.items())
+    fq_dir = tmp / "fastq_pass" / "barcode01"
+    fq_dir.mkdir(parents=True)
+    fastx.write_fastq(fq_dir / "barcode01.fastq.gz", lib.reads)
+    return tmp, lib
+
+
+def _run(src, root, ledger: str, live_port: int | None):
+    from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+    from ont_tcrconsensus_tpu.pipeline.run import run_with_config
+
+    root.mkdir(parents=True, exist_ok=True)
+    shutil.copy(src / "reference.fa", root / "reference.fa")
+    shutil.copytree(src / "fastq_pass", root / "fastq_pass")
+    raw = {
+        "reference_file": str(root / "reference.fa"),
+        "fastq_pass_dir": str(root / "fastq_pass"),
+        "minimal_length": 600,
+        "min_reads_per_cluster": 4,
+        "read_batch_size": 64,
+        "polish_method": "poa",
+        "delete_tmp_files": False,
+        "history_ledger": ledger,
+    }
+    if live_port is not None:
+        raw["live_port"] = live_port
+    cfg = RunConfig.from_dict(raw)
+    return run_with_config(cfg), root / "fastq_pass" / "nano_tcr"
+
+
+def _fetch(url: str) -> tuple[int, str, str]:
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return (resp.status, resp.headers.get("Content-Type", ""),
+                    resp.read().decode())
+    except urllib.error.HTTPError as err:
+        return err.code, err.headers.get("Content-Type", ""), ""
+
+
+@pytest.fixture(scope="module")
+def live_runs(live_library, tmp_path_factory):
+    """Run A (live off) seeds the shared ledger with per-node priors and
+    is the byte-identity baseline; run B (live_port=0) gates
+    round1_polish open while a probe thread scrapes all three endpoints
+    mid-run and SIGUSR1-flushes the flight recorder."""
+    from ont_tcrconsensus_tpu.graph import nodes as graph_nodes
+
+    src, lib = live_library
+    ledger = str(tmp_path_factory.mktemp("live_ledger") / "ledger.jsonl")
+    res_a, nano_a = _run(src, tmp_path_factory.mktemp("live_off"), ledger,
+                         live_port=None)
+
+    in_node = threading.Event()
+    release = threading.Event()
+    probed: dict[str, object] = {}
+
+    orig = graph_nodes.round1_polish
+
+    def gated_round1_polish(ctx, inputs):
+        in_node.set()
+        release.wait(timeout=60.0)
+        return orig(ctx, inputs)
+
+    def probe():
+        try:
+            if not in_node.wait(timeout=300.0):
+                probed["error"] = "round1_polish never entered"
+                return
+            srv = obs_live.server()
+            if srv is None:
+                probed["error"] = "live server not armed"
+                return
+            base = f"http://127.0.0.1:{srv.port}"
+            for route in ("/healthz", "/metrics", "/progress", "/nope"):
+                probed[route] = _fetch(base + route)
+            os.kill(os.getpid(), signal.SIGUSR1)
+            # the handler runs on the main thread (blocked in an
+            # interruptible Event.wait inside the gated node): give the
+            # flush a moment to land before releasing the node
+            time.sleep(1.0)
+        except Exception as exc:  # surfaced by the consuming tests
+            probed["error"] = repr(exc)
+        finally:
+            release.set()
+
+    t = threading.Thread(target=probe, name="live-probe", daemon=True)
+    graph_nodes.round1_polish = gated_round1_polish
+    try:
+        t.start()
+        res_b, nano_b = _run(src, tmp_path_factory.mktemp("live_on"),
+                             ledger, live_port=0)
+    finally:
+        graph_nodes.round1_polish = orig
+        release.set()
+        t.join(timeout=30.0)
+    return lib, res_a, nano_a, res_b, nano_b, probed, ledger
+
+
+def test_live_e2e_endpoints_serve_mid_run(live_runs):
+    _, _, _, _, _, probed, _ = live_runs
+    assert "error" not in probed, probed.get("error")
+    status, ctype, body = probed["/healthz"]
+    health = json.loads(body)
+    assert status == 200 and ctype.startswith("application/json")
+    assert health["status"] in ("ok", "stalled")
+    assert health["pid"] == os.getpid()
+    assert health["flight_recorder"]["capacity"] == obs_live.MAX_RING_EVENTS
+    status, ctype, body = probed["/metrics"]
+    assert status == 200 and ctype.startswith("text/plain")
+    fams = validate_prometheus(body)
+    assert fams["tcr_up"] == 1
+    # the probe's own /healthz hit was counted before /metrics rendered
+    assert 'tcr_counter_total{site="live.requests"}' in body
+    # stages upstream of the gated round1_polish have completed spans
+    assert fams.get("tcr_stage_seconds_total", 0) >= 1
+    assert probed["/nope"][0] == 404
+
+
+def test_live_e2e_progress_eta_from_history_priors(live_runs):
+    _, _, _, _, _, probed, _ = live_runs
+    assert "error" not in probed, probed.get("error")
+    status, _, body = probed["/progress"]
+    assert status == 200
+    prog = json.loads(body)
+    assert prog["library"] == "barcode01"
+    assert prog["libraries_total"] == 1 and prog["libraries_done"] == 0
+    assert prog["node"] == "round1_polish"
+    assert 0 <= prog["nodes_done"] < prog["nodes_total"]
+    # run A's ledger entry supplies per-node priors for THIS fingerprint
+    assert prog["eta_basis"] == "history_priors"
+    assert prog["eta_s"] is not None and prog["eta_s"] > 0.0
+
+
+def test_live_e2e_sigusr1_flushes_schema_valid_flight_recorder(live_runs):
+    _, _, _, _, nano_b, probed, _ = live_runs
+    assert "error" not in probed, probed.get("error")
+    rec = json.loads((nano_b / "logs" / "flight_recorder.json").read_text())
+    assert rec["schema"] == obs_live.FLIGHT_SCHEMA
+    assert rec["reason"] == "sigusr1"
+    assert rec["pid"] == os.getpid()
+    assert isinstance(rec["dropped"], int) and rec["dropped"] >= 0
+    kinds = {e["kind"] for e in rec["events"]}
+    # spans from completed stages, instants from arming/robustness, and
+    # heartbeats from the assign/cluster batch loops all reach the ring
+    assert {"span", "instant", "heartbeat"} <= kinds
+    names = {e["name"] for e in rec["events"]}
+    assert "flight.flush" in names
+    for ev in rec["events"]:
+        assert isinstance(ev["t_s"], float) and ev["thread"]
+    # and --report renders the tail from the committed artifact
+    text, rc = obs_report.render_report(str(nano_b))
+    assert rc == 0
+    assert "flight recorder flight_recorder.json: flushed on 'sigusr1'" \
+        in text
+
+
+def test_live_e2e_outputs_byte_identical_to_live_off(live_runs):
+    lib, res_a, nano_a, res_b, nano_b, _, _ = live_runs
+    assert res_a == res_b == {"barcode01": lib.true_counts}
+    for rel in (
+        ("barcode01", "counts", "umi_consensus_counts.csv"),
+        ("barcode01", "fasta", "merged_consensus.fasta"),
+    ):
+        a = nano_a.joinpath(*rel).read_bytes()
+        b = nano_b.joinpath(*rel).read_bytes()
+        assert a == b, f"the live plane must not change {'/'.join(rel)}"
+
+
+def test_live_e2e_ledger_entries_carry_node_seconds(live_runs):
+    """Satellite: obs/history.py records per-node seconds, so the ETA
+    priors and the critical-path analyzer share one source of truth."""
+    _, _, _, _, _, _, ledger = live_runs
+    entries, problems = obs_history.read_entries(ledger)
+    assert problems == [] and len(entries) == 2  # run A + run B
+    for entry in entries:
+        nodes = entry["nodes"]
+        assert "round1_polish" in nodes
+        for g in nodes.values():
+            assert g["s"] >= 0.0 and g["runs"] >= 1
+    # the priors run B served its ETA from are reconstructible
+    fp = entries[0]["fingerprint"]
+    assert entries[1]["fingerprint"] == fp  # live_port is excluded
+    priors = obs_live.load_node_priors([ledger], fp)
+    assert priors["round1_polish"]["s"] >= 0.0
+
+
+def test_live_e2e_plane_is_disarmed_after_run(live_runs):
+    """run.py's finally must fully disarm: slots cleared, taps unwired,
+    port released (the module sentinel contract holds again)."""
+    assert obs_live._RING is None and obs_live._PROGRESS is None
+    assert obs_live.server() is None
+    assert obs_trace._RING is None
+    assert watchdog._BEAT_SINK is None and watchdog._EXPIRY_SINK is None

@@ -9,8 +9,9 @@ never be populated. Both directions, cross-file:
 
 - ``obs-unknown-site``   — a site literal passed to a telemetry plant
   function (``counter_add`` / ``gauge_max`` / ``observe`` / ``span`` /
-  ``instant`` / ``dispatch`` / ``timed_get`` / ``StageTimer.stage``) that
-  is not an ``obs.KNOWN_SITES`` entry;
+  ``instant`` / ``dispatch`` / ``timed_get`` / ``StageTimer.stage``, plus
+  the live-plane plants ``ring_event`` and the ``progress_node_*``
+  family from ``obs/live.py``) that is not an ``obs.KNOWN_SITES`` entry;
 - ``obs-unplanted-site`` — a registry entry never planted in the scanned
   tree (reported at the entry's own line).
 
@@ -38,8 +39,8 @@ from tools.graftlint.core import FileCtx, Finding, Project
 RULES = {
     "obs-unknown-site": "telemetry site literal (counter_add/gauge_max/"
                         "observe/pool_add/span/instant/dispatch/timed_get/"
-                        "stage) not in obs.KNOWN_SITES (dead metric/span "
-                        "name)",
+                        "stage/ring_event/progress_node_*) not in "
+                        "obs.KNOWN_SITES (dead metric/span name)",
     "obs-unplanted-site": "obs.KNOWN_SITES entry not planted at any "
                           "telemetry call site in the scanned tree",
 }
@@ -55,6 +56,13 @@ _PLANT_FUNCS = {
     # executor derives span/timer names from the declared node name, so a
     # declaration IS a telemetry plant (graph node names must be
     # OBS_SITES entries; see rules/graph_sites.py)
+    "ring_event",                           # obs.live — flight-recorder
+    # instants; literal event names are site names a --report reader
+    # greps for, so they live in the same vocabulary
+    "progress_node_start", "progress_node_finish",  # obs.live — the
+    "progress_node_skip",                   # /progress plane keys its
+    # node map by graph node name (literal plants only; the executor's
+    # node.name args are dynamic and out of scope, like f-string sites)
 }
 
 _REGISTRY_NAME = "OBS_SITES"
