@@ -141,6 +141,69 @@ def run_once(root: str, live_port: int | None = None):
     return results, dt, cfg
 
 
+def run_daemon_bench(root: str, args) -> tuple[float, float, dict, object]:
+    """The --daemon arm: cold-start vs steady-state through the warm-serving
+    daemon (serve/daemon.py) instead of two bare run_with_config calls.
+
+    Cold-start = daemon construction -> first job done (template validation,
+    compile-cache arming, AOT bucket prewarm, and the first job's residual
+    compiles all included — the number the ≤10s goal is judged against once
+    the persistent cache is primed). Steady-state = the second job's
+    dispatch-to-done seconds through the already-warm process; its
+    telemetry.json compile count ~0 is the ROADMAP-3 success signal.
+    Returns (cold_s, steady_s, steady job snapshot, daemon).
+    """
+    import threading
+
+    from ont_tcrconsensus_tpu.serve.daemon import Daemon
+
+    shutil.rmtree(os.path.join(root, "fastq_pass", "nano_tcr"),
+                  ignore_errors=True)
+    template = {
+        "reference_file": os.path.join(root, "reference.fa"),
+        "fastq_pass_dir": os.path.join(root, "fastq_pass"),
+        "minimal_length": 1000,
+        "min_reads_per_cluster": 4,
+        "read_batch_size": 1024,
+        "delete_tmp_files": False,
+    }
+    t0 = time.time()
+    daemon = Daemon(template, port=args.live_port or 0,
+                    state_dir=os.path.join(root, "serve_state"))
+    loop = threading.Thread(target=daemon.serve_forever,
+                            name="bench-daemon", daemon=True)
+    loop.start()
+
+    def run_job() -> dict:
+        status, snap = daemon.submit({})
+        if status != 202:
+            raise RuntimeError(f"daemon rejected the bench job "
+                               f"({status}): {snap}")
+        deadline = time.time() + 3600.0
+        while time.time() < deadline:
+            cur = daemon.job_snapshot(snap["id"])
+            if cur is not None and cur["state"] in ("done", "failed"):
+                if cur["state"] == "failed":
+                    raise RuntimeError(
+                        f"{snap['id']} failed: {cur['error']}")
+                return cur
+            time.sleep(0.2)
+        raise RuntimeError(f"{snap['id']} did not finish within an hour")
+
+    try:
+        run_job()
+        cold_s = time.time() - t0
+        # fresh output tree: the steady-state job is a new tenant, not a
+        # resume of the first one
+        shutil.rmtree(os.path.join(root, "fastq_pass", "nano_tcr"))
+        job2 = run_job()
+        steady_s = job2["finished_t"] - job2["started_t"]
+    finally:
+        daemon.request_stop()
+        loop.join(timeout=60.0)
+    return cold_s, steady_s, job2, daemon
+
+
 def assignment_accuracy(root: str, lib) -> float:
     """Fraction of round-1 surviving reads binned into the region cluster
     that contains their true region (ground truth from simulator headers)."""
@@ -247,6 +310,14 @@ def parse_args(argv=None):
         "the capture is appended to the ledger either way",
     )
     ap.add_argument(
+        "--daemon", action="store_true",
+        help="run the jobs through the warm-serving daemon (serve/) "
+        "instead of two bare pipeline calls: cold-start (daemon start + "
+        "AOT prewarm + first job) and steady-state (second job through "
+        "the warm process) land as warmup_s/steady_s in the JSON line "
+        "and the ledger entry",
+    )
+    ap.add_argument(
         "--live-port", type=int, default=None, metavar="PORT",
         help="arm the live observability plane (obs/live.py) for the bench "
         "runs: /healthz, /metrics, /progress on 127.0.0.1:PORT (0 = "
@@ -312,10 +383,36 @@ def main(argv=None) -> int:
     lib = build_dataset(root)
     n_reads = len(lib.reads)
 
-    # warm-up run compiles every kernel; timed run measures steady state
+    # warm-up run compiles every kernel; timed run measures steady state.
+    # --daemon measures the same split through the serve daemon instead.
+    daemon_extra: dict | None = None
     try:
-        _, warm_dt, _ = run_once(root, live_port=args.live_port)
-        results, dt, cfg = run_once(root, live_port=args.live_port)
+        if args.daemon:
+            from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+            from ont_tcrconsensus_tpu.pipeline.run import _read_counts_csv
+
+            warm_dt, dt, job2, daemon = run_daemon_bench(root, args)
+            results = {"barcode01": _read_counts_csv(os.path.join(
+                root, "fastq_pass", "nano_tcr", "barcode01", "counts",
+                "umi_consensus_counts.csv"))}
+            cfg = RunConfig.from_dict({
+                "reference_file": os.path.join(root, "reference.fa"),
+                "fastq_pass_dir": os.path.join(root, "fastq_pass"),
+                "minimal_length": 1000,
+                "min_reads_per_cluster": 4,
+                "read_batch_size": 1024,
+                "delete_tmp_files": False,
+            })
+            pre = daemon.prewarm_report or {}
+            daemon_extra = {
+                "dispatch_first_stage_s": job2.get("first_stage_s"),
+                "prewarm_compiled": pre.get("compiled", 0),
+                "prewarm_failed": pre.get("failed", 0),
+                "prewarm_seconds": pre.get("seconds", 0.0),
+            }
+        else:
+            _, warm_dt, _ = run_once(root, live_port=args.live_port)
+            results, dt, cfg = run_once(root, live_port=args.live_port)
     except Exception as exc:  # backend died mid-run: still record a JSON line
         import traceback
 
@@ -342,7 +439,13 @@ def main(argv=None) -> int:
         }
         print(f"bench: count diffs (got, want): {diff}", file=sys.stderr)
     print(f"bench: stage timing {timing}", file=sys.stderr)
-    emit_extra = {"n_reads": n_reads, "counts_exact": counts_ok}
+    # warm/steady split (cross-run schema shared with the serve ledger
+    # entries): warmup_s is compile-dominated, steady_s is the number the
+    # throughput claims rest on
+    emit_extra = {"n_reads": n_reads, "counts_exact": counts_ok,
+                  "warmup_s": round(warm_dt, 3), "steady_s": round(dt, 3)}
+    if daemon_extra is not None:
+        emit_extra["daemon"] = daemon_extra
     # cross-run keys (obs/history.py): the committed BENCH_*.json line and
     # the history ledger share one schema, so a capture file IS a valid
     # baseline entry and trend scripts need no translation layer
@@ -395,6 +498,7 @@ def main(argv=None) -> int:
         "bench", read_raw_telemetry(root), fingerprint=fingerprint,
         sha=sha, backend=backend, n_reads=n_reads,
         reads_per_sec=round(reads_per_sec, 2),
+        warmup_s=warm_dt, steady_s=dt,
         extra={"counts_exact": counts_ok, "duration_s": round(dt, 3)},
     )
     if args.gate:

@@ -535,6 +535,7 @@ def validate_inputs(config_path: str, out=None, as_json: bool = False) -> int:
     out = out if out is not None else sys.stdout
     problems: list[str] = []
     graftcheck_body: dict | None = None
+    compile_cache: dict | None = None
 
     def p(*parts):
         if not as_json:
@@ -546,6 +547,7 @@ def validate_inputs(config_path: str, out=None, as_json: bool = False) -> int:
                 "config": config_path,
                 "ok": rc == 0,
                 "problems": problems,
+                "compile_cache": compile_cache,
                 "graftcheck": graftcheck_body,
             }, indent=2), file=out)
         return rc
@@ -560,6 +562,22 @@ def validate_inputs(config_path: str, out=None, as_json: bool = False) -> int:
         p(f"PROBLEM: {problems[0]}")
         p("validate: FAIL (1 problem)")
         return finish(1)
+
+    # persistent XLA compilation cache resolution (same rules as
+    # pipeline/run.py enable_compilation_cache, without importing jax):
+    # "off" disables, null means the default user-cache path. Surfaced so
+    # an operator can see where warm-start executables will land — and
+    # whether a daemon restart will find them — before any device work.
+    if cfg.compile_cache_dir == "off":
+        compile_cache = {"enabled": False, "dir": None}
+        p("validate: compile cache: disabled (compile_cache_dir=\"off\")")
+    else:
+        resolved = cfg.compile_cache_dir or os.path.join(
+            os.path.expanduser("~"), ".cache", "ont_tcrconsensus_tpu_xla")
+        compile_cache = {"enabled": True, "dir": resolved,
+                         "exists": os.path.isdir(resolved)}
+        p(f"validate: compile cache: {resolved}"
+          f"{' (will be created)' if not compile_cache['exists'] else ''}")
 
     # executor knob: a graph-executor config must declare a graph that
     # passes builder validation (cycles, undeclared/dangling edges, hbm

@@ -91,6 +91,19 @@ OBS_SITES = frozenset({
     "live.requests",
     "live.serve",
     "flight.flush",
+    # --- warm-serving daemon (serve/): queue admission counters + depth
+    # gauge + wait/first-stage histograms (metrics.*) and job-lifecycle
+    # flight-ring instants (live.ring_event) ---
+    "serve.submitted",
+    "serve.rejected",
+    "serve.requeued",
+    "serve.done",
+    "serve.failed",
+    "serve.queue_depth",
+    "serve.wait_s",
+    "serve.first_stage_s",
+    "serve.job",
+    "serve.drain",
 })
 
 KNOWN_SITES = OBS_SITES

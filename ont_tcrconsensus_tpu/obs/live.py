@@ -35,9 +35,14 @@ Arming follows the established one-module-attr-check discipline
 the config knob ``live_port`` defaults to null and every planted site
 below (``ring_event``, ``progress_node_start`` /...) reduces to one
 module-attribute check when disarmed — nothing listens, nothing buffers.
-Security posture: the server binds 127.0.0.1 ONLY, serves GET only, and
-exposes no mutating route; remote scrapes go through an operator's own
-port-forward, never a config knob.
+Security posture: the server binds 127.0.0.1 ONLY; remote scrapes go
+through an operator's own port-forward, never a config knob. One-shot
+runs serve GET only. Under the warm-serving daemon (serve/daemon.py) the
+SAME loopback-only server additionally accepts ``POST /jobs`` and serves
+``GET /jobs`` / ``GET /jobs/<id>`` — the single mutating route exists
+only while a daemon has armed a jobs controller
+(:func:`set_jobs_controller`); without one, POST answers 503 and the
+plane stays read-only.
 """
 
 from __future__ import annotations
@@ -431,9 +436,15 @@ def _healthz_payload() -> dict:
     }
 
 
+#: request-body cap for POST /jobs — a job submission is a small JSON
+#: config-overrides object; anything larger is a client bug, not a job
+MAX_JOB_BODY_BYTES = 1 << 20
+
+
 class _Handler(BaseHTTPRequestHandler):
-    """GET-only, read-only routes; access logging silenced (the endpoint
-    is scraped every few seconds — stderr noise would drown run logs)."""
+    """Read-only GET routes, plus POST /jobs when a daemon armed a jobs
+    controller; access logging silenced (the endpoint is scraped every
+    few seconds — stderr noise would drown run logs)."""
 
     server_version = "tcr-live/1"
 
@@ -446,6 +457,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _send_json(self, status: int, payload: dict) -> None:
+        self._send(status, "application/json", json.dumps(payload).encode())
 
     def do_GET(self) -> None:  # noqa: N802 - stdlib handler name
         metrics.counter_add("live.requests")
@@ -464,9 +478,74 @@ class _Handler(BaseHTTPRequestHandler):
                 payload = tracker.snapshot() if tracker is not None else {}
                 self._send(200, "application/json",
                            json.dumps(payload).encode())
+            elif path == "/jobs" or path.startswith("/jobs/"):
+                self._get_jobs(path)
             else:
                 self._send(404, "text/plain; charset=utf-8",
-                           b"unknown route; try /healthz /metrics /progress\n")
+                           b"unknown route; try /healthz /metrics /progress"
+                           b" /jobs\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away mid-write; nothing to serve
+
+    def _get_jobs(self, path: str) -> None:
+        ctl = _JOBS
+        if ctl is None:
+            self._send_json(503, {
+                "error": "no jobs controller armed — /jobs exists only "
+                         "under the serve daemon (tcr-consensus-tpu serve)",
+            })
+            return
+        if path == "/jobs":
+            self._send_json(200, ctl.jobs_snapshot())
+            return
+        snap = ctl.job_snapshot(path[len("/jobs/"):])
+        if snap is None:
+            self._send_json(404, {"error": "unknown job id"})
+        else:
+            self._send_json(200, snap)
+
+    def do_POST(self) -> None:  # noqa: N802 - stdlib handler name
+        """The plane's single mutating route: submit a job to the armed
+        daemon controller. Loopback bind remains the security boundary;
+        without a controller (every one-shot run) this answers 503 and
+        the plane is exactly as read-only as before."""
+        metrics.counter_add("live.requests")
+        path = self.path.split("?", 1)[0]
+        try:
+            if path != "/jobs":
+                self._send_json(404, {"error": "POST supports /jobs only"})
+                return
+            ctl = _JOBS
+            if ctl is None:
+                self._send_json(503, {
+                    "error": "no jobs controller armed — POST /jobs exists "
+                             "only under the serve daemon",
+                })
+                return
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                length = -1
+            if length <= 0:
+                self._send_json(400, {"error": "missing request body"})
+                return
+            if length > MAX_JOB_BODY_BYTES:
+                self._send_json(413, {
+                    "error": f"job body over {MAX_JOB_BODY_BYTES} bytes",
+                })
+                return
+            try:
+                obj = json.loads(self.rfile.read(length))
+            except ValueError:
+                self._send_json(400, {"error": "body is not valid JSON"})
+                return
+            if not isinstance(obj, dict):
+                self._send_json(400, {
+                    "error": "body must be a JSON object of config overrides",
+                })
+                return
+            status, payload = ctl.submit(obj)
+            self._send_json(status, payload)
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away mid-write; nothing to serve
 
@@ -501,6 +580,12 @@ class LiveServer:
 _RING: FlightRecorder | None = None
 _PROGRESS: ProgressTracker | None = None
 _SERVER: LiveServer | None = None
+# daemon-mode jobs controller (serve/daemon.py duck type: submit(dict) ->
+# (status, payload), jobs_snapshot() -> dict, job_snapshot(id) -> dict|None)
+_JOBS = None
+# one-shot observer of graph-node starts (the daemon's dispatch-to-first-
+# stage latency tap); called OUTSIDE the tracker lock, exceptions swallowed
+_NODE_START_HOOK = None
 
 
 def _flush_on_expiry(stage: str) -> None:
@@ -527,11 +612,13 @@ def disarm() -> None:
     """Tear the plane down (run.py calls this in its finally): unwire the
     taps FIRST so in-flight spans stop feeding a dead ring, then stop the
     server so the port is released for the next run in-process."""
-    global _RING, _PROGRESS, _SERVER
+    global _RING, _PROGRESS, _SERVER, _JOBS, _NODE_START_HOOK
     srv = _SERVER
     _SERVER = None
     _RING = None
     _PROGRESS = None
+    _JOBS = None
+    _NODE_START_HOOK = None
     trace.set_ring(None)
     watchdog.set_beat_sink(None)
     watchdog.set_expiry_sink(None)
@@ -541,6 +628,22 @@ def disarm() -> None:
 
 def server() -> LiveServer | None:
     return _SERVER
+
+
+def set_jobs_controller(ctl) -> None:
+    """Arm (or with None, disarm) the daemon jobs controller behind
+    POST/GET ``/jobs``. Owned by serve/daemon.py; one-shot runs never
+    call this, so their plane serves no mutating route."""
+    global _JOBS
+    _JOBS = ctl
+
+
+def set_node_start_hook(fn) -> None:
+    """Arm (or with None, disarm) a graph-node-start observer. The serve
+    daemon uses this as its dispatch-to-first-stage latency tap: armed at
+    job dequeue, self-disarming at the first node."""
+    global _NODE_START_HOOK
+    _NODE_START_HOOK = fn
 
 
 def ring_event(site: str, args: dict | None = None) -> None:
@@ -602,6 +705,12 @@ def progress_node_start(name: str, units: int | None = None) -> None:
     tracker = _PROGRESS
     if tracker is not None:
         tracker.node_start(name, units)
+    hook = _NODE_START_HOOK
+    if hook is not None:
+        try:
+            hook(name)
+        except Exception:
+            pass  # an observer must never fail the stage it observes
 
 
 def progress_node_finish(name: str, seconds: float,

@@ -40,6 +40,16 @@ def _install_stack_dump_signal() -> None:
 
 def main(argv: list[str] | None = None) -> int:
     _install_stack_dump_signal()
+    if argv is None:
+        argv = sys.argv[1:]
+    # subcommand dispatch ahead of the one-shot parser: `serve` turns the
+    # CLI into the long-lived warm daemon (serve/daemon.py) with its own
+    # argument surface; everything else keeps the legacy single-positional
+    # form untouched
+    if argv and argv[0] == "serve":
+        from ont_tcrconsensus_tpu.serve.daemon import serve_main
+
+        return serve_main(argv[1:])
     parser = argparse.ArgumentParser(
         description="Count unique TCR molecule nanopore consensus reads (TPU-native)."
     )

@@ -174,6 +174,24 @@ class RunConfig:
     #   sites are one module-attr check and nothing ever listens. Binds
     #   loopback only and serves no mutating route; excluded from the
     #   config fingerprint (observation, not workload)
+    compile_cache_dir: str | None = None  # persistent XLA compilation cache
+    #   (jax_compilation_cache_dir): null (default) uses
+    #   ~/.cache/ont_tcrconsensus_tpu_xla, any other string is used as the
+    #   cache directory, and "off" disables the persistent cache entirely.
+    #   A warm-serving daemon (serve/) points this at durable storage so a
+    #   restarted daemon reloads executables instead of recompiling.
+    #   Excluded from the config fingerprint (an executable cache location,
+    #   not a workload knob)
+    serve_queue_max: int = 8  # daemon mode only (serve/queue.py): bounded
+    #   tenant job queue depth; a submit beyond this is rejected with
+    #   reason "queue_full" instead of queued unboundedly. Ignored by
+    #   one-shot runs; excluded from the config fingerprint
+    serve_prewarm: bool = True  # daemon mode only (serve/prewarm.py): AOT
+    #   lower+compile the fused-assign (and polisher, when weights are
+    #   bundled) entry points for the declared width buckets at daemon
+    #   start, so the first job pays no compile latency. False skips the
+    #   prewarm (first job compiles lazily). Ignored by one-shot runs;
+    #   excluded from the config fingerprint
     history_ledger: str | None = None  # opt-in CROSS-run ledger path (e.g.
     #   a repo-level BENCH_HISTORY.jsonl): every telemetry-armed run
     #   appends its history entry there in addition to the per-run
@@ -397,6 +415,22 @@ class RunConfig:
             raise ValueError(
                 f"history_ledger={self.history_ledger!r} must be a non-empty "
                 "path string or null"
+            )
+        if self.compile_cache_dir is not None and (
+            not isinstance(self.compile_cache_dir, str)
+            or not self.compile_cache_dir
+        ):
+            raise ValueError(
+                f"compile_cache_dir={self.compile_cache_dir!r} must be a "
+                "non-empty path string, \"off\" (cache disabled) or null "
+                "(null = the default ~/.cache path)"
+            )
+        if not isinstance(self.serve_queue_max, int) or (
+            isinstance(self.serve_queue_max, bool) or self.serve_queue_max < 1
+        ):
+            raise ValueError(
+                f"serve_queue_max={self.serve_queue_max!r} must be a "
+                "positive int"
             )
         for pat_name in ("umi_fwd", "umi_rev"):
             pat = getattr(self, pat_name)

@@ -66,20 +66,32 @@ def _log(*parts):
     print(*parts, file=sys.stderr)
 
 
-def enable_compilation_cache(cache_dir: str | None = None) -> None:
+def enable_compilation_cache(cache_dir: str | None = None) -> dict:
     """Persist XLA executables across processes (first compile of the kernel
     set costs minutes; every later pipeline invocation then starts warm).
-    Safe no-op when the backend rejects the cache."""
+    Safe no-op when the backend rejects the cache.
+
+    ``cache_dir`` is the ``compile_cache_dir`` config knob: None arms the
+    default ``~/.cache`` path, ``"off"`` disables the persistent cache,
+    anything else is the cache directory. Returns an ``{"armed", "dir"}``
+    status dict (recorded into telemetry.json's analysis section)."""
     import jax
 
+    if cache_dir == "off":
+        try:
+            jax.config.update("jax_compilation_cache_dir", None)
+        except Exception:
+            pass
+        return {"armed": False, "dir": None}
+    resolved = cache_dir or os.path.expanduser(
+        "~/.cache/ont_tcrconsensus_tpu_xla")
     try:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            cache_dir or os.path.expanduser("~/.cache/ont_tcrconsensus_tpu_xla"),
-        )
+        jax.config.update("jax_compilation_cache_dir", resolved)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception as exc:  # unsupported backend/config: run cold
         _log(f"compilation cache unavailable: {exc!r}")
+        return {"armed": False, "dir": resolved, "error": repr(exc)}
+    return {"armed": True, "dir": resolved}
 
 
 def run_pipeline(config_path: str, polisher=None,
@@ -242,6 +254,7 @@ def _run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]
     # while still armed; at "off" the planted sites stay one
     # module-attribute check.
     sampler = None
+    run_armed_live = False
     sigquit_log = _SigquitRunLog()
     live_usr1 = obs_live.Sigusr1Hook()
     try:
@@ -254,9 +267,13 @@ def _run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]
         # The live plane arms independently of the telemetry level: its
         # flight ring is the post-mortem context for runs where the full
         # trace collector is NOT armed, and /metrics stays a valid (if
-        # sparse) exposition even at telemetry=off.
-        if cfg.live_port is not None:
+        # sparse) exposition even at telemetry=off. Under the warm-serving
+        # daemon (serve/) the plane is DAEMON-owned — already armed before
+        # this run started — so the run neither re-arms nor disarms it:
+        # only a plane armed here is torn down here.
+        if cfg.live_port is not None and obs_live.server() is None:
             srv = obs_live.arm(cfg.live_port)
+            run_armed_live = True
             live_usr1.install()
             _log(f"Live observability plane on http://127.0.0.1:{srv.port} "
                  "(/healthz /metrics /progress; SIGUSR1 flushes the "
@@ -275,7 +292,8 @@ def _run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]
             raise
     finally:
         live_usr1.restore()
-        obs_live.disarm()
+        if run_armed_live:
+            obs_live.disarm()
         if sampler is not None:
             sampler.stop()
         obs_trace.disarm()
@@ -291,7 +309,11 @@ def _run_with_config_body(
 ) -> dict[str, dict[str, int]]:
     from ont_tcrconsensus_tpu.parallel import distributed as dist
 
-    enable_compilation_cache()
+    # arm (or explicitly disarm, "off") the persistent XLA executable cache
+    # per the validated knob, and record the outcome in telemetry.json so a
+    # cold-start regression is attributable to cache state, not guessed
+    cache_state = enable_compilation_cache(cfg.compile_cache_dir)
+    obs_metrics.analysis_set("compile_cache", cache_state)
     # fault-tolerant execution layer (robustness/): every run DECLARES its
     # chaos state — the config key wins over the TCR_CHAOS env var, and
     # with neither present any stale plan from a previous in-process run

@@ -126,20 +126,32 @@ class ShutdownCoordinator:
 
 
 # process-wide active coordinator, mirroring faults/retry: deep stage code
-# polls checkpoints without plumbing the coordinator through signatures
+# polls checkpoints without plumbing the coordinator through signatures.
+# Kept as a STACK so nesting works: the warm-serving daemon (serve/) holds
+# an outer coordinator for its accept loop while each job's run.py guard
+# activates an inner one — when the job deactivates, the daemon's
+# coordinator must become active again, not None.
 _ACTIVE: ShutdownCoordinator | None = None
+_STACK: list[ShutdownCoordinator] = []
 
 
 def activate(coord: ShutdownCoordinator) -> ShutdownCoordinator:
     global _ACTIVE
+    _STACK.append(coord)
     _ACTIVE = coord
     return coord
 
 
 def deactivate(coord: ShutdownCoordinator | None = None) -> None:
+    """Pop ``coord`` (default: the top) off the active stack; the previous
+    coordinator — if any — becomes active again."""
     global _ACTIVE
-    if coord is None or _ACTIVE is coord:
-        _ACTIVE = None
+    if coord is None:
+        if _STACK:
+            _STACK.pop()
+    elif coord in _STACK:
+        _STACK.remove(coord)
+    _ACTIVE = _STACK[-1] if _STACK else None
 
 
 def request(reason: str) -> None:

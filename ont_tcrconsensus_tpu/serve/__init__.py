@@ -1,0 +1,34 @@
+"""Warm-serving daemon: the one-shot CLI turned into an always-on service.
+
+ROADMAP item 3's blocking number is r5's 165.8s of XLA warm-up per process
+against a 46.8s timed run: a production service cannot pay compile time
+per library. The fix is a LONG-LIVED process that arms jax, the persistent
+compilation cache and the live observability plane once, then runs every
+submitted job through :func:`~..pipeline.run.run_with_config` in-process —
+the module-level ``jax.jit`` entry points (fused assign, targeted assign,
+consensus, polisher) keep their compiled executables across jobs, so the
+second tenant's traffic triggers ZERO backend compiles (the PR 6
+``backend_compile`` listener in each job's own telemetry.json is the
+regression sentinel).
+
+Three pieces:
+
+- :mod:`.queue`  — bounded FIFO tenant job queue with admission control
+  from the HBM budgeter (:mod:`~..parallel.budget`): a job whose estimated
+  device footprint cannot fit the configured budget is rejected with a
+  named reason at submit time, not OOM-killed mid-run. Queue depth /
+  wait-time land in the metrics registry, so the live plane's ``/metrics``
+  exposes them.
+- :mod:`.prewarm` — AOT prewarm of the fixed production shape buckets:
+  lower+compile the fused-assign and polisher entry points for the
+  declared bucket set at daemon start, on top of the persistent
+  ``compile_cache_dir`` — a restarted daemon reads executables back from
+  disk instead of recompiling.
+- :mod:`.daemon` — the long-lived loop plus the loopback-only HTTP
+  control plane riding the PR 13 live server (POST ``/jobs``, GET
+  ``/jobs`` and ``/jobs/<id>``; same 127.0.0.1 posture). SIGTERM drains:
+  the in-flight job stops at the next stage boundary through the existing
+  :mod:`~..robustness.shutdown` machinery, the remaining queue is
+  journaled, and a restarted daemon resumes the journal through verified
+  resume.
+"""

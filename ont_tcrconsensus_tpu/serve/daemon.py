@@ -1,0 +1,391 @@
+"""The warm-serving daemon loop + its loopback HTTP control plane.
+
+One process arms everything exactly once — the persistent compilation
+cache, the daemon-scope metrics registry, the live plane (now with the
+``/jobs`` routes) — then runs every accepted job through the unchanged
+:func:`~..pipeline.run.run_with_config`. Artifact byte-identity with the
+one-shot CLI is therefore structural: jobs execute the same code path;
+the daemon only decides WHEN, and keeps the process (and with it every
+module-level jitted entry point's compiled executables) alive between
+jobs. The second job with production shapes dispatches with ZERO backend
+compiles — its own telemetry.json proves it via the PR 6 compile
+listener.
+
+Lifecycle:
+
+- start: template config validated -> compile cache armed -> live plane
+  + jobs controller up (``serve_info.json`` in the state dir records the
+  resolved port + pid) -> drain journal resumed -> AOT prewarm
+  (serve/prewarm.py) -> accept loop.
+- job: merged overrides revalidated, ``live_port`` forced off (the
+  daemon owns the plane), dispatch-to-first-stage latency measured via
+  the live plane's node-start hook, a ``source: "serve"`` ledger entry
+  appended next to the run's own (warmup_s on the first job, steady_s
+  per job).
+- SIGTERM: the in-flight job drains at its next stage boundary through
+  the standard shutdown coordinator (its committed stages resume), every
+  unfinished job is journaled, exit code 143; a restarted daemon loads
+  the journal and resumes the jobs with ``resume=true`` forced through
+  verified resume.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+
+from ont_tcrconsensus_tpu.obs import history as obs_history
+from ont_tcrconsensus_tpu.obs import live as obs_live
+from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
+from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+from ont_tcrconsensus_tpu.robustness import shutdown
+from ont_tcrconsensus_tpu.serve import prewarm as prewarm_mod
+from ont_tcrconsensus_tpu.serve import queue as queue_mod
+
+SERVE_INFO_BASENAME = "serve_info.json"
+
+
+def _log(*parts):
+    print("serve:", *parts, file=sys.stderr)
+
+
+@dataclasses.dataclass
+class _JobOutcome:
+    state: str
+    error: str | None = None
+    result: dict | None = None
+
+
+class Daemon:
+    """The long-lived serving loop; also the live plane's jobs controller
+    (duck type behind ``POST /jobs`` — :meth:`submit`,
+    :meth:`jobs_snapshot`, :meth:`job_snapshot`)."""
+
+    def __init__(self, template: dict, *, port: int, state_dir: str,
+                 queue_max: int | None = None, do_prewarm: bool | None = None,
+                 prewarm_widths: list[int] | None = None):
+        self.template = dict(template)
+        # the template must itself be a complete, valid run config: every
+        # job inherits it, so a broken template fails at daemon start, not
+        # on the first tenant's submit
+        self.template_cfg = RunConfig.from_dict(dict(template))
+        self.port = port
+        self.state_dir = state_dir
+        self.prewarm_widths = prewarm_widths
+        self.do_prewarm = (self.template_cfg.serve_prewarm
+                           if do_prewarm is None else do_prewarm)
+        from ont_tcrconsensus_tpu.parallel import budget as budget_mod
+
+        self.budget = budget_mod.BudgetModel(
+            self.template_cfg.hbm_budget_gb
+            if self.template_cfg.hbm_budget_gb is not None
+            else budget_mod.detect_hbm_gb()
+        )
+        self.queue = queue_mod.JobQueue(
+            queue_max if queue_max is not None
+            else self.template_cfg.serve_queue_max,
+            self.budget,
+        )
+        self.prewarm_report: dict | None = None
+        self.warmup_s: float | None = None
+        self.jobs_done = 0
+        self._t0 = time.monotonic()
+        self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._coord = shutdown.ShutdownCoordinator()
+
+    # --- jobs controller (HTTP handler threads) ----------------------------
+
+    def submit(self, overrides: dict) -> tuple[int, dict]:
+        if self._draining.is_set() or self._stop.is_set():
+            return 503, {"error": "draining",
+                         "detail": "daemon is draining; resubmit after "
+                                   "restart (queued jobs are journaled)"}
+        merged = dict(self.template)
+        merged.update(overrides)
+        # the daemon owns the live plane; a job must not re-point it
+        merged["live_port"] = None
+        try:
+            cfg = RunConfig.from_dict(merged)
+        except Exception as exc:
+            err = self.queue.reject("invalid_config", str(exc))
+            return 400, {"error": err.reason, "detail": err.detail}
+        try:
+            job = self.queue.submit(merged, cfg)
+        except queue_mod.AdmissionError as exc:
+            status = 429 if exc.reason == "queue_full" else 409
+            return status, {"error": exc.reason, "detail": exc.detail}
+        obs_live.ring_event("serve.job", {"id": job.id, "event": "queued"})
+        snap = job.snapshot()
+        snap["queue_depth"] = self.queue.depth()
+        return 202, snap
+
+    def jobs_snapshot(self) -> dict:
+        return {
+            "jobs": self.queue.snapshot(),
+            "queue_depth": self.queue.depth(),
+            "draining": self._draining.is_set(),
+            "jobs_done": self.jobs_done,
+            "warmup_s": self.warmup_s,
+            "prewarm": self.prewarm_report,
+        }
+
+    def job_snapshot(self, job_id: str) -> dict | None:
+        job = self.queue.job(job_id)
+        return job.snapshot() if job is not None else None
+
+    def request_stop(self) -> None:
+        """Programmatic drain (tests / embedders): same path as SIGTERM
+        minus the signal, exit code 0."""
+        self._stop.set()
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def _write_info(self, srv_port: int) -> None:
+        os.makedirs(self.state_dir, exist_ok=True)
+        path = os.path.join(self.state_dir, SERVE_INFO_BASENAME)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"port": srv_port, "pid": os.getpid(),
+                       "t_wall": round(time.time(), 3)}, fh, indent=1)
+        os.replace(tmp, path)
+
+    def _resume_journal(self) -> None:
+        for rec in queue_mod.load_journal(self.state_dir):
+            raw = dict(rec["raw"])
+            # committed stages of a drained job must resume, not refuse
+            # on the existing output tree
+            raw["resume"] = True
+            raw["live_port"] = None
+            try:
+                cfg = RunConfig.from_dict(raw)
+                self.queue.submit(raw, cfg)
+                _log(f"journal: resumed {rec.get('id')} as a fresh job")
+            except Exception as exc:
+                _log(f"journal: dropped {rec.get('id')}: {exc!r}")
+
+    def _prewarm(self) -> None:
+        if not self.do_prewarm:
+            self.prewarm_report = {"skipped": "serve_prewarm off",
+                                   "entries": [], "seconds": 0.0}
+            return
+        from ont_tcrconsensus_tpu.cluster import regions as regions_mod
+        from ont_tcrconsensus_tpu.io import fastx
+        from ont_tcrconsensus_tpu.pipeline import run as run_mod
+        from ont_tcrconsensus_tpu.pipeline import stages
+
+        cfg = self.template_cfg
+        reference = fastx.read_fasta_dict(cfg.reference_file)
+        homology = regions_mod.self_homology_map(
+            reference, cfg.cluster_identity)
+        panel = stages.ReferencePanel.build(
+            reference, homology.region_cluster)
+        read_batch, budget = run_mod.resolve_batching(
+            cfg, len(panel.names), None)
+        engine = stages.AssignEngine(
+            panel, cfg.umi_fwd, cfg.umi_rev,
+            primers=cfg.primer_sequences(),
+            primer_max_dist_frac=cfg.primer_max_dist_frac,
+            a5=cfg.max_softclip_5_end, a3=cfg.max_softclip_3_end,
+            trim_window=cfg.trim_window, band_width=cfg.sw_band_width,
+            fast_denom=4 if cfg.round1_fast_assign else 0,
+        )
+        self.prewarm_report = prewarm_mod.prewarm(
+            cfg, engine, read_batch, budget, widths=self.prewarm_widths)
+        obs_metrics.analysis_set("serve_prewarm", self.prewarm_report)
+        _log(f"prewarm: {self.prewarm_report.get('compiled', 0)} program(s) "
+             f"in {self.prewarm_report.get('seconds', 0.0)}s")
+
+    def serve_forever(self) -> int:
+        """Arm, prewarm, loop until drained; returns the exit code (143
+        for a signal-initiated drain, 0 for a programmatic stop)."""
+        from ont_tcrconsensus_tpu.pipeline import run as run_mod
+
+        cache_state = run_mod.enable_compilation_cache(
+            self.template_cfg.compile_cache_dir)
+        obs_metrics.arm()
+        obs_metrics.analysis_set("compile_cache", cache_state)
+        srv = obs_live.arm(self.port)
+        obs_live.set_flush_path(os.path.join(
+            self.state_dir, "logs", "flight_recorder.json"))
+        obs_live.set_jobs_controller(self)
+        self._write_info(srv.port)
+        installed = self._coord.install()
+        shutdown.activate(self._coord)
+        _log(f"daemon up on http://127.0.0.1:{srv.port} "
+             f"(/jobs /healthz /metrics /progress; pid {os.getpid()}"
+             f"{'' if installed else '; cooperative stop only'})")
+        exit_code = 0
+        try:
+            self._resume_journal()
+            self._prewarm()
+            self.warmup_s = round(time.monotonic() - self._t0, 3)
+            _log(f"warm after {self.warmup_s}s; accepting jobs")
+            while True:
+                if self._coord.requested():
+                    exit_code = 143
+                    break
+                if self._stop.is_set():
+                    break
+                job = self.queue.pop(timeout=0.25)
+                if job is None:
+                    continue
+                if self._coord.requested() or self._stop.is_set():
+                    # drained between pop and dispatch: back on the head
+                    self.queue.requeue_front(job)
+                    exit_code = 143 if self._coord.requested() else 0
+                    break
+                if not self._run_job(job):
+                    exit_code = 143
+                    break
+        finally:
+            self._draining.set()
+            drained = self.queue.drain_jobs()
+            path = queue_mod.write_journal(self.state_dir, drained)
+            if path:
+                _log(f"drain: journaled {len(drained)} job(s) to {path}")
+            obs_live.flush_armed("serve_drain")
+            obs_live.set_jobs_controller(None)
+            obs_live.disarm()
+            obs_metrics.disarm()
+            shutdown.deactivate(self._coord)
+            self._coord.uninstall()
+        return exit_code
+
+    # --- one job -------------------------------------------------------------
+
+    def _run_job(self, job: queue_mod.Job) -> bool:
+        """Run one job through the unchanged pipeline; False = drained
+        mid-job (the job is requeued + the caller exits the loop)."""
+        from ont_tcrconsensus_tpu.pipeline import run as run_mod
+
+        obs_live.ring_event("serve.job", {"id": job.id, "event": "start"})
+        _log(f"{job.id}: starting (waited {job.wait_s:.3f}s)")
+        cfg = RunConfig.from_dict(dict(job.raw))
+        t_dispatch = time.monotonic()
+
+        def first_stage_hook(name: str) -> None:
+            job.first_stage_s = time.monotonic() - t_dispatch
+            obs_live.set_node_start_hook(None)
+            obs_metrics.observe("serve.first_stage_s", job.first_stage_s)
+
+        obs_live.set_node_start_hook(first_stage_hook)
+        outcome = _JobOutcome("done")
+        try:
+            results = run_mod.run_with_config(cfg)
+            outcome.result = {
+                "libraries": {
+                    lib: sum(regions.values())
+                    for lib, regions in sorted(results.items())
+                },
+            }
+        except shutdown.Preempted as preempted:
+            # not swallowed: the caller exits the serve loop with code 143
+            # on False; finished stages are committed and the restarted
+            # daemon resumes the rest through verified resume
+            job.raw["resume"] = True
+            self.queue.requeue_front(job)
+            obs_live.ring_event(
+                "serve.drain", {"id": job.id, "reason": str(preempted)})
+            _log(f"{job.id}: drained mid-run ({preempted}); requeued with "
+                 f"resume=true")
+            return False
+        except Exception as exc:
+            outcome = _JobOutcome("failed", error=repr(exc))
+        finally:
+            obs_live.set_node_start_hook(None)
+            # the job's run disarmed its registry on exit; re-arm a fresh
+            # daemon-scope one so between-job /metrics scrapes stay live
+            obs_metrics.arm()
+            obs_metrics.gauge_max("serve.queue_depth", self.queue.depth())
+        job_s = time.monotonic() - t_dispatch
+        self.queue.mark(job, outcome.state, error=outcome.error,
+                        result=outcome.result)
+        self.jobs_done += 1
+        obs_live.ring_event("serve.job", {
+            "id": job.id, "event": outcome.state,
+        })
+        if outcome.state == "done":
+            self._record_ledger(job, cfg, job_s)
+            _log(f"{job.id}: done in {job_s:.3f}s "
+                 f"(first stage after {job.first_stage_s:.3f}s)"
+                 if job.first_stage_s is not None else
+                 f"{job.id}: done in {job_s:.3f}s")
+        else:
+            _log(f"{job.id}: failed: {outcome.error}")
+        return True
+
+    def _record_ledger(self, job: queue_mod.Job, cfg: RunConfig,
+                       job_s: float) -> None:
+        """Append the ``source: "serve"`` entry: the dispatch-to-first-
+        stage latency and warm/steady split, next to the run's own entry
+        (same never-fail contract as every telemetry path)."""
+        try:
+            entry = obs_history.build_entry(
+                "serve",
+                fingerprint=obs_history.config_fingerprint(cfg),
+                sha=obs_history.git_sha(),
+                backend=obs_history.detect_backend(),
+                warmup_s=self.warmup_s if self.jobs_done == 1 else None,
+                steady_s=job_s,
+                extra={
+                    "job_id": job.id,
+                    "wait_s": round(job.wait_s or 0.0, 3),
+                    "dispatch_first_stage_s": (
+                        round(job.first_stage_s, 3)
+                        if job.first_stage_s is not None else None),
+                },
+            )
+            nano_dir = os.path.join(cfg.fastq_pass_dir, "nano_tcr")
+            obs_history.append_entry(
+                os.path.join(nano_dir, obs_history.HISTORY_BASENAME), entry)
+            if cfg.history_ledger:
+                obs_history.append_entry(cfg.history_ledger, entry)
+        except Exception as exc:
+            _log(f"WARNING: could not append serve ledger entry: {exc!r}")
+
+
+def serve_main(argv: list[str] | None = None) -> int:
+    """``tcr-consensus-tpu serve <template.json>`` entry point."""
+    parser = argparse.ArgumentParser(
+        prog="tcr-consensus-tpu serve",
+        description="Warm-serving daemon: accepts pipeline jobs over a "
+                    "loopback-only HTTP control plane (POST /jobs) and "
+                    "runs them through one long-lived, prewarmed process.",
+    )
+    parser.add_argument("template", help="template run-config JSON every "
+                                         "job's overrides merge onto")
+    parser.add_argument("--port", type=int, default=8765,
+                        help="loopback control-plane port (0 = ephemeral; "
+                             "resolved port lands in serve_info.json)")
+    parser.add_argument("--state-dir", default=None,
+                        help="daemon state dir (serve_info.json + drain "
+                             "journal); default: serve_state/ next to the "
+                             "template")
+    parser.add_argument("--queue-max", type=int, default=None,
+                        help="override the template's serve_queue_max")
+    parser.add_argument("--no-prewarm", action="store_true",
+                        help="skip the AOT bucket prewarm (first job "
+                             "compiles lazily)")
+    parser.add_argument("--cpu", action="store_true",
+                        help="force the CPU backend (simulation)")
+    args = parser.parse_args(argv)
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    with open(args.template) as fh:
+        template = json.load(fh)
+    state_dir = args.state_dir or os.path.join(
+        os.path.dirname(os.path.abspath(args.template)), "serve_state")
+    daemon = Daemon(
+        template, port=args.port, state_dir=state_dir,
+        queue_max=args.queue_max,
+        do_prewarm=False if args.no_prewarm else None,
+    )
+    return daemon.serve_forever()

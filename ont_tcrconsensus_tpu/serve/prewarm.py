@@ -1,0 +1,156 @@
+"""AOT prewarm of the production shape buckets at daemon start.
+
+The serving-loop discipline: every steady-state dispatch must hit an
+executable compiled BEFORE traffic arrived. The pipeline already bounds
+its shape space by construction — reads pad into the power-of-two
+``DEFAULT_WIDTHS`` buckets at one budget-derived batch size, polish tiles
+into (cluster_batch, s_bucket, width) tiles — so the declared bucket set
+is enumerable from the config alone, and each entry point can be
+``.lower(...).compile()``-d ahead of the first job with
+``jax.ShapeDtypeStruct`` stand-ins for the batch arrays.
+
+Compiled programs land in the jitted entry points' in-process caches
+(the daemon's steady-state hits) AND in the persistent
+``compile_cache_dir`` (a restarted daemon's cold start reads them back
+instead of recompiling — that is what makes the ≤10s dispatch-to-first-
+stage goal reachable after the very first deployment).
+
+Every bucket compiles under try/except into a report entry: prewarm is an
+optimization, and a signature drift between this module and the entry
+points must degrade to a visible report line + lazy first-job compile,
+never a dead daemon. (The signature is pinned by tests/test_serve.py.)
+"""
+
+from __future__ import annotations
+
+import time
+
+from ont_tcrconsensus_tpu.io import bucketing
+
+
+def declared_width_buckets(cfg) -> list[int]:
+    """The read-batch width buckets this config's traffic can produce:
+    every declared width up to the one covering ``max_read_length``."""
+    cap = (bucketing.bucket_width(cfg.max_read_length)
+           or cfg.max_read_length)
+    return [w for w in bucketing.DEFAULT_WIDTHS if w <= cap] or [cap]
+
+
+def _prewarm_fused_assign(cfg, engine, read_batch: int, widths: list[int],
+                          report: list[dict]) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ont_tcrconsensus_tpu.pipeline.assign import _fused_pass
+
+    # round-1 fastq serving: quals present; the SW fast path keys the
+    # static signature exactly as AssignEngine.run_batch_async does
+    fast = engine.fast_denom > 0 and engine.top_k == 2
+    statics = engine._static_kwargs(has_quals=True, fast=fast)
+    for width in widths:
+        t0 = time.monotonic()
+        entry = {"kind": "fused_assign", "batch": read_batch,
+                 "width": width}
+        try:
+            args = (
+                jax.ShapeDtypeStruct((read_batch, width), jnp.uint8),
+                jax.ShapeDtypeStruct((read_batch, width), jnp.uint8),
+                jax.ShapeDtypeStruct((read_batch,), jnp.int32),
+                engine.panel.d_codes, engine.panel.d_lens,
+                engine.panel.d_profiles,
+                engine.umi_masks, engine.umi_mask_lens,
+                engine.primer_stack, engine.primer_stack_lens,
+                engine.primer_max_dists,
+                jnp.float32(cfg.max_ee_rate_base),
+                jnp.int32(cfg.minimal_length),
+                jnp.float32(cfg.minimal_region_overlap),
+            )
+            _fused_pass.lower(*args, **statics).compile()
+            entry["ok"] = True
+        except Exception as exc:
+            entry["ok"] = False
+            entry["error"] = repr(exc)
+        entry["seconds"] = round(time.monotonic() - t0, 3)
+        report.append(entry)
+
+
+def _prewarm_polisher(cfg, budget, widths: list[int],
+                      report: list[dict]) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ont_tcrconsensus_tpu.models import polisher as polisher_mod
+
+    params = polisher_mod.load_default_params()
+    if params is None or cfg.polish_method != "rnn":
+        report.append({"kind": "polisher", "ok": False,
+                       "error": "skipped: no bundled weights or "
+                                "polish_method != rnn", "seconds": 0.0})
+        return
+    wants_v4 = (polisher_mod.params_feature_dim(params)
+                == polisher_mod.FEATURE_DIM_V4)
+    use_bf16 = cfg.polish_bf16 and polisher_mod.bf16_serving_certified(
+        min_polish_depth=cfg.min_polish_depth)
+    s_bucket = bucketing.pow2_ceil(max(cfg.max_reads_per_cluster, 1))
+    # the production polish tile: subreads are full-length reads, so the
+    # dominant width bucket is the read-length one — prewarm the largest
+    # declared width (the expensive program) at the budget-derived tile
+    width = max(widths)
+    eff_band = (cfg.sw_band_width if width <= 2048
+                else max(cfg.sw_band_width, 128))
+    cb = cfg.cluster_batch_size or budget.cluster_batch(
+        s_bucket, width, eff_band, keep_final_pileup=True,
+        keep_pos=wants_v4)
+    t0 = time.monotonic()
+    entry = {"kind": "polisher", "batch": cb, "s_bucket": s_bucket,
+             "width": width, "band": eff_band, "v4": wants_v4}
+    try:
+        sds = jax.ShapeDtypeStruct
+        polisher_mod._device_polish_batch_jit.lower(
+            params,
+            sds((cb, s_bucket, width), jnp.uint8),   # sub
+            sds((cb, s_bucket), jnp.int32),          # lens
+            sds((cb, width), jnp.uint8),             # drafts
+            sds((cb,), jnp.int32),                   # dlens
+            eff_band,
+            quals=sds((cb, s_bucket, width), jnp.uint8) if wants_v4 else None,
+            is_rev=sds((cb, s_bucket), jnp.bool_) if wants_v4 else None,
+            bf16=use_bf16,
+        ).compile()
+        entry["ok"] = True
+    except Exception as exc:
+        entry["ok"] = False
+        entry["error"] = repr(exc)
+    entry["seconds"] = round(time.monotonic() - t0, 3)
+    report.append(entry)
+
+
+def prewarm(cfg, engine, read_batch: int, budget,
+            widths: list[int] | None = None) -> dict:
+    """Lower+compile the declared bucket set; returns the report dict
+    (recorded into the daemon's telemetry via ``analysis_set`` and the
+    serve ledger entries' ``warmup_s``).
+
+    ``engine`` is the daemon's round-1 :class:`AssignEngine` (its device
+    constants are the real lowering inputs), ``read_batch``/``budget``
+    come from :func:`~..pipeline.run.resolve_batching`. ``widths``
+    overrides the declared bucket set (tests prewarm one small bucket).
+    Mesh-sharded configs are declared unsupported here: the sharded entry
+    points cache per-engine, so a daemon restart cannot reuse them
+    anyway — they compile lazily on the first job.
+    """
+    t0 = time.monotonic()
+    report: list[dict] = []
+    if cfg.mesh_shape:
+        return {"skipped": "mesh_shape set — sharded entry points "
+                           "prewarm lazily on the first job",
+                "entries": [], "seconds": 0.0}
+    widths = list(widths) if widths else declared_width_buckets(cfg)
+    _prewarm_fused_assign(cfg, engine, read_batch, widths, report)
+    _prewarm_polisher(cfg, budget, widths, report)
+    return {
+        "entries": report,
+        "compiled": sum(1 for e in report if e.get("ok")),
+        "failed": sum(1 for e in report if not e.get("ok")),
+        "seconds": round(time.monotonic() - t0, 3),
+    }

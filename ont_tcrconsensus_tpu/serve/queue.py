@@ -1,0 +1,305 @@
+"""Bounded tenant job queue with HBM-budget admission control.
+
+The reference's Ray cluster solves multi-tenant scheduling with a resource
+scheduler the TPU pipeline doesn't have; the serve daemon's queue is the
+minimal sound replacement: FIFO order (tenants share one device, fairness
+is arrival order), a hard depth bound, and ADMISSION control from the same
+:class:`~ont_tcrconsensus_tpu.parallel.budget.BudgetModel` arithmetic the
+pipeline sizes its batches with — a job whose requested shapes cannot fit
+the budget even at the minimum device batch is rejected at submit time
+with a machine-readable reason, not accepted and OOM-killed forty minutes
+in.
+
+Queue state is observable two ways, matching the repo's discipline:
+counters / gauges / histograms planted into the armed metrics registry
+(``serve.submitted`` / ``serve.rejected`` / ``serve.queue_depth`` /
+``serve.wait_s`` — the live plane's ``/metrics`` exposes them between and
+during jobs) and a JSON journal (:func:`write_journal` /
+:func:`load_journal`) the daemon uses for SIGTERM drain: queued + requeued
+jobs survive the process and a restarted daemon resumes them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import threading
+import time
+
+from ont_tcrconsensus_tpu.io import bucketing
+from ont_tcrconsensus_tpu.obs import metrics
+from ont_tcrconsensus_tpu.parallel.budget import BudgetModel
+
+JOURNAL_SCHEMA = 1
+JOURNAL_BASENAME = "serve_journal.json"
+
+#: jobs remembered after they leave the queue (done/failed/rejected) so
+#: ``GET /jobs/<id>`` keeps answering; oldest-first eviction past this
+MAX_FINISHED_REMEMBERED = 64
+
+
+class AdmissionError(Exception):
+    """A job the queue refuses to accept; ``reason`` is machine-readable
+    (``queue_full`` / ``invalid_config`` / ``over_budget`` / ...)."""
+
+    def __init__(self, reason: str, detail: str):
+        self.reason = reason
+        self.detail = detail
+        super().__init__(f"{reason}: {detail}")
+
+
+@dataclasses.dataclass
+class Job:
+    """One tenant submission: raw config overrides plus lifecycle state.
+
+    ``raw`` is the tenant's JSON object as submitted (merged over the
+    daemon's template config at run time); lifecycle timestamps are wall
+    seconds. States: queued -> running -> done | failed; requeued (drain
+    journaled the job mid-queue; resumes with ``resume=true`` forced).
+    """
+
+    id: str
+    raw: dict
+    state: str = "queued"
+    submitted_t: float = 0.0
+    started_t: float | None = None
+    finished_t: float | None = None
+    error: str | None = None
+    result: dict | None = None
+    wait_s: float | None = None
+    first_stage_s: float | None = None
+
+    def snapshot(self) -> dict:
+        return {
+            "id": self.id,
+            "state": self.state,
+            "submitted_t": round(self.submitted_t, 3),
+            "started_t": (round(self.started_t, 3)
+                          if self.started_t is not None else None),
+            "finished_t": (round(self.finished_t, 3)
+                           if self.finished_t is not None else None),
+            "wait_s": (round(self.wait_s, 3)
+                       if self.wait_s is not None else None),
+            "first_stage_s": (round(self.first_stage_s, 3)
+                              if self.first_stage_s is not None else None),
+            "error": self.error,
+            "result": self.result,
+        }
+
+
+def estimate_admission(cfg, budget: BudgetModel) -> tuple[bool, str]:
+    """(admissible, detail) for a validated config against the budget.
+
+    Mirrors the shapes :func:`~..pipeline.run.resolve_batching` and the
+    polish tiler actually allocate: the fused read pass at the requested
+    (or minimum derivable) read batch, and one polish cluster tile at the
+    config's subread bucket. Estimation only — the run still sizes its
+    real batches from the same model, so an admitted job cannot exceed
+    what admission measured.
+    """
+    # bucket_width is None past the largest declared width: batches of
+    # longer reads pad to max_read_length itself
+    width = bucketing.bucket_width(cfg.max_read_length) or cfg.max_read_length
+    per_read = budget.read_bytes(width, band_width=cfg.sw_band_width)
+    if cfg.read_batch_size is not None:
+        need = per_read * cfg.read_batch_size
+        if need > budget.budget_bytes:
+            return False, (
+                f"read_batch_size={cfg.read_batch_size} at width {width} "
+                f"needs ~{need / 1e9:.2f} GB > working budget "
+                f"{budget.budget_bytes / 1e9:.2f} GB"
+            )
+    elif per_read * 128 > budget.budget_bytes:
+        return False, (
+            f"max_read_length={cfg.max_read_length} (width {width}) cannot "
+            f"fit even the minimum 128-read batch in the working budget "
+            f"{budget.budget_bytes / 1e9:.2f} GB"
+        )
+    s_bucket = bucketing.pow2_ceil(max(cfg.max_reads_per_cluster, 1))
+    if budget.cluster_bytes(s_bucket, width) > budget.budget_bytes:
+        return False, (
+            f"one polish tile of {s_bucket} subreads x width {width} "
+            f"exceeds the working budget {budget.budget_bytes / 1e9:.2f} GB"
+        )
+    return True, "fits"
+
+
+class JobQueue:
+    """Bounded FIFO with admission control and a drain journal.
+
+    Thread contract: the HTTP handler threads submit and snapshot; the
+    daemon loop pops and mutates job state through :meth:`mark`. One lock
+    guards every structure (declared for graftlint's lock-discipline rule
+    below); the condition wakes the pop side on submit/requeue.
+    """
+
+    def __init__(self, max_depth: int, budget: BudgetModel):
+        self.max_depth = int(max_depth)
+        self.budget = budget
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self.pending: list[Job] = []
+        self.jobs: dict[str, Job] = {}
+        self.finished_order: list[str] = []
+        self._seq = itertools.count(1)
+
+    # --- submit side (HTTP handler threads) -------------------------------
+
+    def submit(self, raw: dict, cfg) -> Job:
+        """Admit ``raw`` (already merged + validated into ``cfg``) or
+        raise :class:`AdmissionError`. Plants the queue metrics either
+        way — a rejection storm must be visible on /metrics."""
+        ok, detail = estimate_admission(cfg, self.budget)
+        with self._lock:
+            if not ok:
+                metrics.counter_add("serve.rejected")
+                raise AdmissionError("over_budget", detail)
+            if len(self.pending) >= self.max_depth:
+                metrics.counter_add("serve.rejected")
+                raise AdmissionError(
+                    "queue_full",
+                    f"queue depth {len(self.pending)} at serve_queue_max="
+                    f"{self.max_depth}",
+                )
+            job = Job(id=f"job-{next(self._seq):04d}", raw=dict(raw),
+                      submitted_t=time.time())
+            self.pending.append(job)
+            self.jobs[job.id] = job
+            metrics.counter_add("serve.submitted")
+            metrics.gauge_max("serve.queue_depth", len(self.pending))
+            self._nonempty.notify()
+            return job
+
+    def reject(self, reason: str, detail: str) -> AdmissionError:
+        """Count + build an admission error for daemon-side rejections
+        (invalid config, draining) so every refusal path meters alike."""
+        metrics.counter_add("serve.rejected")
+        return AdmissionError(reason, detail)
+
+    # --- pop side (daemon loop) -------------------------------------------
+
+    def pop(self, timeout: float | None = None) -> Job | None:
+        """Next job in FIFO order (state -> running), or None on timeout."""
+        with self._lock:
+            if not self.pending and not self._nonempty.wait(timeout):
+                return None
+            if not self.pending:  # woken by requeue_front during drain
+                return None
+            job = self.pending.pop(0)
+            job.state = "running"
+            job.started_t = time.time()
+            job.wait_s = job.started_t - job.submitted_t
+            metrics.observe("serve.wait_s", job.wait_s)
+            return job
+
+    def requeue_front(self, job: Job) -> None:
+        """Put a drained in-flight job back at the head (state ->
+        requeued; the journal writes it first so restart order is FIFO)."""
+        with self._lock:
+            job.state = "requeued"
+            metrics.counter_add("serve.requeued")
+            self.pending.insert(0, job)
+            self._nonempty.notify()
+
+    def mark(self, job: Job, state: str, *, error: str | None = None,
+             result: dict | None = None) -> None:
+        """Terminal transition (done/failed) + bounded finished memory."""
+        with self._lock:
+            job.state = state
+            job.finished_t = time.time()
+            job.error = error
+            job.result = result
+            if state == "done":
+                metrics.counter_add("serve.done")
+            else:
+                metrics.counter_add("serve.failed")
+            self.finished_order.append(job.id)
+            while len(self.finished_order) > MAX_FINISHED_REMEMBERED:
+                dead = self.finished_order.pop(0)
+                self.jobs.pop(dead, None)
+
+    # --- observation -------------------------------------------------------
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self.pending)
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return [j.snapshot() for j in self.jobs.values()]
+
+    def job(self, job_id: str) -> Job | None:
+        with self._lock:
+            return self.jobs.get(job_id)
+
+    def drain_jobs(self) -> list[Job]:
+        """Every not-yet-terminal job in resume order (requeued in-flight
+        first, then FIFO pending) for the drain journal."""
+        with self._lock:
+            return list(self.pending)
+
+
+# graftlint lock-discipline: HTTP handler threads and the daemon loop both
+# mutate these; any mutation outside the lock loses jobs under load
+LOCK_OWNERSHIP = {
+    "JobQueue.pending": "_lock",
+    "JobQueue.jobs": "_lock",
+    "JobQueue.finished_order": "_lock",
+}
+
+
+# --- drain journal ------------------------------------------------------------
+
+
+def journal_path(state_dir: str) -> str:
+    return os.path.join(state_dir, JOURNAL_BASENAME)
+
+
+def write_journal(state_dir: str, jobs: list[Job]) -> str | None:
+    """Atomically journal ``jobs`` for a restarted daemon; removes any
+    stale journal (and returns None) when there is nothing to carry."""
+    path = journal_path(state_dir)
+    if not jobs:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return None
+    os.makedirs(state_dir, exist_ok=True)
+    payload = {
+        "schema": JOURNAL_SCHEMA,
+        "t_wall": round(time.time(), 3),
+        "jobs": [
+            {"id": j.id, "raw": j.raw, "state": j.state,
+             "submitted_t": round(j.submitted_t, 3)}
+            for j in jobs
+        ],
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def load_journal(state_dir: str) -> list[dict]:
+    """Read + consume the drain journal: entries in resume order, the
+    file removed (its content now lives in the daemon's queue). Garbage
+    degrades to an empty list — a torn journal must not wedge restarts."""
+    path = journal_path(state_dir)
+    try:
+        with open(path) as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError):
+        return []
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+    jobs = payload.get("jobs") if isinstance(payload, dict) else None
+    if not isinstance(jobs, list):
+        return []
+    return [j for j in jobs if isinstance(j, dict) and isinstance(
+        j.get("raw"), dict)]

@@ -25,9 +25,13 @@
 # quiet on an identical replay and exits nonzero on a seeded +30%
 # regression; --report --critical-path explains the executed graph
 # consistently with wall time), the differential ingest fuzzer
-# standalone (5 seeds), and a seeded-corpus replay through the ASan/UBSan
+# standalone (5 seeds), a seeded-corpus replay through the ASan/UBSan
 # parser build (scripts/fuzz_ingest.py --sanitized; the >=1000-corpus
-# campaigns are the slow-marked tests).
+# campaigns are the slow-marked tests), and a warm-serving daemon smoke
+# (one warm daemon serves two HTTP-submitted jobs — the second with ZERO
+# steady-state compiles and outputs byte-identical to the one-shot CLI —
+# plus the slow-marked drain e2e: SIGTERM-equivalent stop mid-queue ->
+# journal -> restarted daemon resumes both jobs to correct counts).
 
 set -o pipefail
 cd "$(dirname "$0")/.."
@@ -175,5 +179,18 @@ src=$?
 if [ "$src" -ne 0 ]; then
     echo "sanitized fuzz smoke FAILED (rc=$src)" >&2
     exit "$src"
+fi
+echo "--- warm-serving daemon smoke (warm daemon: job 2 dispatches with 0"
+echo "    XLA compiles + byte-identical artifacts; drain journals the queue"
+echo "    and a restarted daemon resumes it) ---"
+# -m 'slow or not slow' overrides the default '-m not slow' addopts so the
+# slow-marked drain/restart e2e runs here by name
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q \
+    -k "serve_e2e or drain_journals" -m 'slow or not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+drc=$?
+if [ "$drc" -ne 0 ]; then
+    echo "daemon smoke FAILED (rc=$drc)" >&2
+    exit "$drc"
 fi
 echo "tier-1 OK"

@@ -1,0 +1,596 @@
+"""Warm-serving daemon (serve/): queue admission + drain journal units,
+/jobs control-plane routes, and the two e2e contracts the subsystem
+exists for — ZERO steady-state compiles (a second job through one warm
+daemon shows XLA compile count 0 in its own telemetry.json, with counts
+CSV + consensus FASTA byte-identical to the one-shot CLI path) and
+SIGTERM-equivalent drain (in-flight job completes at its next stage
+boundary, the rest journal, a restarted daemon resumes them through
+verified resume).
+
+The warm e2e pair is also the tier-1 daemon smoke (scripts/tier1.sh).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ont_tcrconsensus_tpu.obs import history as obs_history
+from ont_tcrconsensus_tpu.obs import live as obs_live
+from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
+from ont_tcrconsensus_tpu.parallel.budget import BudgetModel
+from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+from ont_tcrconsensus_tpu.robustness import shutdown
+from ont_tcrconsensus_tpu.serve import prewarm as serve_prewarm
+from ont_tcrconsensus_tpu.serve import queue as serve_queue
+from ont_tcrconsensus_tpu.serve.daemon import Daemon
+
+# the suite-wide persistent compile cache (tests/conftest.py): pointing
+# the daemon's knob at it keeps e2e reruns warm across CI invocations
+_TEST_CACHE = os.environ.get(
+    "JAX_TEST_CACHE_DIR",
+    os.path.join(os.path.dirname(__file__), ".jax_cache"),
+)
+
+_BASE = {"reference_file": "r.fa", "fastq_pass_dir": "fq"}
+
+
+def _mini_cfg(**over) -> RunConfig:
+    return RunConfig.from_dict({**_BASE, **over})
+
+
+def _get(url: str) -> tuple[int, dict]:
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, json.loads(resp.read().decode() or "null")
+    except urllib.error.HTTPError as err:
+        body = err.read().decode()
+        return err.code, (json.loads(body) if body.startswith("{") else {})
+
+
+def _post(url: str, obj=None, data: bytes | None = None) -> tuple[int, dict]:
+    payload = json.dumps(obj).encode() if data is None else data
+    req = urllib.request.Request(
+        url, data=payload, method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read().decode() or "null")
+    except urllib.error.HTTPError as err:
+        body = err.read().decode()
+        return err.code, (json.loads(body) if body.startswith("{") else {})
+
+
+# ---------------------------------------------------------------------------
+# config knobs + ledger schema
+
+
+def test_config_serve_knob_validation():
+    cfg = _mini_cfg()
+    assert cfg.compile_cache_dir is None
+    assert cfg.serve_queue_max == 8 and cfg.serve_prewarm is True
+    assert _mini_cfg(compile_cache_dir="off").compile_cache_dir == "off"
+    assert _mini_cfg(compile_cache_dir="/tmp/x").compile_cache_dir == "/tmp/x"
+    for bad in ("", 5, True):
+        with pytest.raises(ValueError, match="compile_cache_dir"):
+            _mini_cfg(compile_cache_dir=bad)
+    assert _mini_cfg(serve_queue_max=1).serve_queue_max == 1
+    for bad in (0, -3, True, "4"):
+        with pytest.raises(ValueError, match="serve_queue_max"):
+            _mini_cfg(serve_queue_max=bad)
+
+
+def test_fingerprint_excludes_serve_and_cache_knobs():
+    fp = obs_history.config_fingerprint(_mini_cfg())
+    varied = _mini_cfg(compile_cache_dir="/tmp/cache", serve_queue_max=2,
+                       serve_prewarm=False, live_port=0)
+    assert obs_history.config_fingerprint(varied) == fp
+    assert obs_history.config_fingerprint(
+        _mini_cfg(read_batch_size=256)) != fp
+
+
+def test_build_entry_warm_steady_split():
+    entry = obs_history.build_entry("serve", warmup_s=12.34567, steady_s=1.5)
+    assert entry["source"] == "serve"
+    assert entry["warmup_s"] == 12.346 and entry["steady_s"] == 1.5
+    bare = obs_history.build_entry("bench")
+    assert "warmup_s" not in bare and "steady_s" not in bare
+
+
+# ---------------------------------------------------------------------------
+# queue: admission, FIFO lifecycle, drain journal
+
+
+def test_queue_admission_queue_full_and_over_budget():
+    q = serve_queue.JobQueue(2, BudgetModel(12.0))
+    j1 = q.submit({"a": 1}, _mini_cfg())
+    assert j1.id == "job-0001" and j1.state == "queued"
+    q.submit({}, _mini_cfg())
+    with pytest.raises(serve_queue.AdmissionError) as ei:
+        q.submit({}, _mini_cfg())
+    assert ei.value.reason == "queue_full"
+    assert q.depth() == 2
+    # a job whose explicit read batch cannot fit the working budget is
+    # rejected at submit time, never accepted and OOM-killed mid-run
+    tight = serve_queue.JobQueue(8, BudgetModel(1.0))
+    with pytest.raises(serve_queue.AdmissionError) as ei:
+        tight.submit({}, _mini_cfg(read_batch_size=1 << 22))
+    assert ei.value.reason == "over_budget"
+    assert "budget" in ei.value.detail
+
+
+def test_queue_pop_mark_requeue_lifecycle():
+    q = serve_queue.JobQueue(8, BudgetModel(12.0))
+    job = q.submit({}, _mini_cfg())
+    popped = q.pop(timeout=0.01)
+    assert popped is job and job.state == "running"
+    assert job.wait_s is not None and job.wait_s >= 0.0
+    q.requeue_front(job)
+    assert job.state == "requeued" and q.depth() == 1
+    assert q.pop(timeout=0.01) is job
+    q.mark(job, "done", result={"libraries": {"barcode01": 5}})
+    snap = q.job(job.id).snapshot()
+    assert snap["state"] == "done" and snap["result"]["libraries"] == \
+        {"barcode01": 5}
+    assert q.pop(timeout=0.01) is None and q.depth() == 0
+
+
+def test_queue_metrics_planted_on_submit_and_reject():
+    reg = obs_metrics.arm()
+    try:
+        q = serve_queue.JobQueue(1, BudgetModel(12.0))
+        q.submit({}, _mini_cfg())
+        with pytest.raises(serve_queue.AdmissionError):
+            q.submit({}, _mini_cfg())
+        summary = reg.summary()
+        assert summary["counters"]["serve.submitted"] == 1
+        assert summary["counters"]["serve.rejected"] == 1
+        assert summary["gauges"]["serve.queue_depth"] == 1
+    finally:
+        obs_metrics.disarm()
+
+
+def test_journal_roundtrip_consume_and_garbage(tmp_path):
+    sd = str(tmp_path)
+    jobs = [serve_queue.Job(id="job-0001", raw={"k": 1}, state="requeued",
+                            submitted_t=1.0),
+            serve_queue.Job(id="job-0002", raw={"k": 2}, submitted_t=2.0)]
+    path = serve_queue.write_journal(sd, jobs)
+    assert path and os.path.exists(path)
+    recs = serve_queue.load_journal(sd)
+    assert [r["id"] for r in recs] == ["job-0001", "job-0002"]
+    assert recs[0]["raw"] == {"k": 1}
+    assert not os.path.exists(path), "journal must be consumed on load"
+    assert serve_queue.load_journal(sd) == []
+    # an empty drain removes any stale journal instead of resurrecting it
+    serve_queue.write_journal(sd, jobs)
+    assert serve_queue.write_journal(sd, []) is None
+    assert not os.path.exists(serve_queue.journal_path(sd))
+    # torn/garbage journals degrade to [] — a restart must never wedge
+    with open(serve_queue.journal_path(sd), "w") as fh:
+        fh.write("{torn")
+    assert serve_queue.load_journal(sd) == []
+    with open(serve_queue.journal_path(sd), "w") as fh:
+        json.dump({"schema": 1, "jobs": [{"id": "x", "raw": "not a dict"},
+                                         "garbage"]}, fh)
+    assert serve_queue.load_journal(sd) == []
+
+
+# ---------------------------------------------------------------------------
+# shutdown coordinator stack (daemon outer / job inner nesting)
+
+
+def test_shutdown_coordinator_stack_nesting():
+    outer = shutdown.ShutdownCoordinator()
+    inner = shutdown.ShutdownCoordinator()
+    shutdown.activate(outer)
+    try:
+        shutdown.activate(inner)
+        shutdown.request("inner stop")
+        assert inner.requested() and not outer.requested()
+        shutdown.deactivate(inner)
+        # the daemon's coordinator is active again, not None
+        shutdown.request("outer stop")
+        assert outer.requested()
+    finally:
+        shutdown.deactivate(outer)
+    assert shutdown._ACTIVE is None and shutdown._STACK == []
+
+
+# ---------------------------------------------------------------------------
+# prewarm bucket enumeration
+
+
+def test_declared_width_buckets():
+    assert serve_prewarm.declared_width_buckets(
+        _mini_cfg(max_read_length=200)) == [256]
+    assert serve_prewarm.declared_width_buckets(
+        _mini_cfg(max_read_length=1000)) == [256, 512, 1024]
+    # past the largest declared width, every declared bucket is in play
+    assert serve_prewarm.declared_width_buckets(
+        _mini_cfg(max_read_length=9000)) == [256, 512, 1024, 2048, 3072,
+                                             4096]
+
+
+# ---------------------------------------------------------------------------
+# /jobs routes (controller-less plane stays read-only; duck-typed controller)
+
+
+def test_post_jobs_without_controller_is_503():
+    srv = obs_live.arm(0)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        assert _post(base + "/jobs", {"x": 1})[0] == 503
+        assert _get(base + "/jobs")[0] == 503
+        assert _get(base + "/healthz")[0] == 200  # read plane unaffected
+    finally:
+        obs_live.disarm()
+    assert obs_live._JOBS is None
+
+
+class _EchoController:
+    def submit(self, obj):
+        return 202, {"id": "job-0001", "echo": obj}
+
+    def jobs_snapshot(self):
+        return {"jobs": [], "queue_depth": 0}
+
+    def job_snapshot(self, job_id):
+        return {"id": job_id} if job_id == "job-0001" else None
+
+
+def test_jobs_routes_with_controller(monkeypatch):
+    srv = obs_live.arm(0)
+    obs_live.set_jobs_controller(_EchoController())
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        status, body = _post(base + "/jobs", {"read_batch_size": 96})
+        assert status == 202 and body["echo"] == {"read_batch_size": 96}
+        assert _post(base + "/nope", {})[0] == 404
+        assert _post(base + "/jobs", data=b"{torn")[0] == 400
+        assert _post(base + "/jobs", data=b"[1, 2]")[0] == 400
+        assert _post(base + "/jobs", data=b"")[0] == 400
+        monkeypatch.setattr(obs_live, "MAX_JOB_BODY_BYTES", 8)
+        assert _post(base + "/jobs", {"k": "0123456789"})[0] == 413
+        status, body = _get(base + "/jobs")
+        assert status == 200 and body["jobs"] == []
+        assert _get(base + "/jobs/job-0001") == (200, {"id": "job-0001"})
+        assert _get(base + "/jobs/zzz")[0] == 404
+    finally:
+        obs_live.set_jobs_controller(None)
+        obs_live.disarm()
+
+
+def test_node_start_hook_fires_and_never_fails_the_stage():
+    seen: list[str] = []
+    obs_live.set_node_start_hook(seen.append)
+    try:
+        obs_live.progress_node_start("round1_polish")
+    finally:
+        obs_live.set_node_start_hook(None)
+    assert seen == ["round1_polish"]
+
+    def boom(name):
+        raise RuntimeError("observer bug")
+
+    obs_live.set_node_start_hook(boom)
+    try:
+        obs_live.progress_node_start("round1_polish")  # must not raise
+    finally:
+        obs_live.set_node_start_hook(None)
+
+
+# ---------------------------------------------------------------------------
+# daemon submit-side validation (no serve loop needed)
+
+
+def test_daemon_submit_validation_and_draining(tmp_path):
+    daemon = Daemon(dict(_BASE), port=0, state_dir=str(tmp_path))
+    status, payload = daemon.submit({"no_such_knob": 1})
+    assert status == 400 and payload["error"] == "invalid_config"
+    status, payload = daemon.submit({"read_batch_size": 1 << 24})
+    assert status == 409 and payload["error"] == "over_budget"
+    status, payload = daemon.submit({"live_port": 0})
+    assert status == 202
+    # the daemon owns the live plane: a tenant cannot re-point it
+    job = daemon.queue.job(payload["id"])
+    assert job.raw["live_port"] is None
+    daemon._draining.set()
+    status, payload = daemon.submit({})
+    assert status == 503 and payload["error"] == "draining"
+
+
+def test_daemon_queue_max_from_template_and_override(tmp_path):
+    daemon = Daemon({**_BASE, "serve_queue_max": 3}, port=0,
+                    state_dir=str(tmp_path))
+    assert daemon.queue.max_depth == 3
+    daemon = Daemon({**_BASE, "serve_queue_max": 3}, port=0,
+                    state_dir=str(tmp_path), queue_max=1)
+    assert daemon.queue.max_depth == 1
+    daemon.submit({})
+    status, payload = daemon.submit({})
+    assert status == 429 and payload["error"] == "queue_full"
+
+
+# ---------------------------------------------------------------------------
+# e2e: one warm daemon, two tenants, zero steady-state compiles,
+# byte-identity vs the one-shot CLI path
+
+
+@pytest.fixture(scope="module")
+def serve_library(tmp_path_factory):
+    from ont_tcrconsensus_tpu.io import fastx, simulator
+
+    tmp = tmp_path_factory.mktemp("serve_lib")
+    lib = simulator.simulate_library(
+        seed=29,
+        num_regions=3,
+        molecules_per_region=(2, 3),
+        reads_per_molecule=(5, 7),
+        sub_rate=0.006,
+        ins_rate=0.003,
+        del_rate=0.003,
+        region_len=(700, 850),
+    )
+    fastx.write_fasta(tmp / "reference.fa", lib.reference.items())
+    fq_dir = tmp / "fastq_pass" / "barcode01"
+    fq_dir.mkdir(parents=True)
+    fastx.write_fastq(fq_dir / "barcode01.fastq.gz", lib.reads)
+    return tmp, lib
+
+
+def _stage(src, root):
+    root.mkdir(parents=True, exist_ok=True)
+    shutil.copy(src / "reference.fa", root / "reference.fa")
+    shutil.copytree(src / "fastq_pass", root / "fastq_pass")
+    return root
+
+
+def _raw_cfg(root, **over) -> dict:
+    raw = {
+        "reference_file": str(root / "reference.fa"),
+        "fastq_pass_dir": str(root / "fastq_pass"),
+        "minimal_length": 600,
+        "min_reads_per_cluster": 4,
+        "read_batch_size": 96,
+        "polish_method": "poa",
+        "delete_tmp_files": False,
+        "compile_cache_dir": _TEST_CACHE,
+    }
+    raw.update(over)
+    return raw
+
+
+def _wait_for_server(timeout: float = 120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        srv = obs_live.server()
+        if srv is not None:
+            return srv
+        time.sleep(0.05)
+    raise AssertionError("daemon never armed its live plane")
+
+
+def _submit_and_wait(jobs_url: str, raw: dict,
+                     timeout: float = 600.0) -> dict:
+    status, snap = _post(jobs_url, raw)
+    assert status == 202, snap
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st, cur = _get(f"{jobs_url}/{snap['id']}")
+        if st == 200 and cur["state"] in ("done", "failed"):
+            return cur
+        time.sleep(0.2)
+    raise AssertionError(f"{snap['id']} did not finish in {timeout}s")
+
+
+@pytest.fixture(scope="module")
+def warm_daemon_runs(serve_library, tmp_path_factory):
+    """One one-shot baseline run, then one warm daemon serving two tenant
+    jobs (identical input content, separate workdirs) over real HTTP."""
+    from ont_tcrconsensus_tpu.pipeline.run import run_with_config
+
+    src, lib = serve_library
+    base = tmp_path_factory.mktemp("serve_e2e")
+    oneshot = _stage(src, base / "oneshot")
+    res_one = run_with_config(RunConfig.from_dict(_raw_cfg(oneshot)))
+    nano_one = oneshot / "fastq_pass" / "nano_tcr"
+
+    w1 = _stage(src, base / "w1")
+    w2 = _stage(src, base / "w2")
+    ledger = str(base / "serve_ledger.jsonl")
+    daemon = Daemon(_raw_cfg(w1, history_ledger=ledger), port=0,
+                    state_dir=str(base / "state"), prewarm_widths=[1024])
+    loop = threading.Thread(target=daemon.serve_forever,
+                            name="serve-e2e", daemon=True)
+    loop.start()
+    try:
+        srv = _wait_for_server()
+        jobs_url = f"http://127.0.0.1:{srv.port}/jobs"
+        snaps = [
+            _submit_and_wait(jobs_url, _raw_cfg(w, history_ledger=ledger))
+            for w in (w1, w2)
+        ]
+        _, listing = _get(jobs_url)
+    finally:
+        daemon.request_stop()
+        loop.join(timeout=120.0)
+    assert not loop.is_alive(), "daemon did not stop"
+    return lib, res_one, nano_one, w1, w2, snaps, listing, daemon, ledger
+
+
+def test_serve_e2e_jobs_complete_with_latency_tap(warm_daemon_runs):
+    _, _, _, _, _, snaps, listing, daemon, _ = warm_daemon_runs
+    for snap in snaps:
+        assert snap["state"] == "done", snap
+        assert snap["wait_s"] is not None and snap["wait_s"] >= 0.0
+        # dispatch-to-first-stage latency measured through the live
+        # plane's node-start hook (the ≤10s goal's measurement channel)
+        assert snap["first_stage_s"] is not None
+        assert snap["first_stage_s"] > 0.0
+    assert listing["jobs_done"] == 2 and listing["queue_depth"] == 0
+    assert daemon.warmup_s is not None and daemon.warmup_s > 0.0
+
+
+def test_serve_e2e_zero_steady_state_compiles(warm_daemon_runs):
+    """The tentpole contract: the SECOND job through the warm daemon
+    dispatches with zero XLA backend compiles — proven by its own
+    telemetry.json via the jax.monitoring compile listener. (Job 1 may
+    legitimately show 0 too: the persistent cache and earlier tests in
+    this process can pre-warm it, so only job 2's count is asserted.)"""
+    _, _, _, _, w2, _, _, _, _ = warm_daemon_runs
+    tele = json.loads(
+        (w2 / "fastq_pass" / "nano_tcr" / "telemetry.json").read_text())
+    assert tele["compile"]["count"] == 0, tele["compile"]
+    # the run recorded which persistent cache it armed
+    cache = tele["analysis"]["compile_cache"]
+    assert cache["armed"] is True and cache["dir"] == _TEST_CACHE
+
+
+def test_serve_e2e_outputs_byte_identical_to_oneshot(warm_daemon_runs):
+    lib, res_one, nano_one, w1, w2, snaps, _, _, _ = warm_daemon_runs
+    assert res_one == {"barcode01": lib.true_counts}
+    total = sum(lib.true_counts.values())
+    for snap in snaps:
+        assert snap["result"]["libraries"] == {"barcode01": total}
+    for rel in (
+        ("barcode01", "counts", "umi_consensus_counts.csv"),
+        ("barcode01", "fasta", "merged_consensus.fasta"),
+    ):
+        want = nano_one.joinpath(*rel).read_bytes()
+        for w in (w1, w2):
+            got = (w / "fastq_pass" / "nano_tcr").joinpath(*rel).read_bytes()
+            assert got == want, \
+                f"daemon path must not change {'/'.join(rel)}"
+
+
+def test_serve_e2e_prewarm_compiled_declared_buckets(warm_daemon_runs):
+    _, _, _, _, _, _, _, daemon, _ = warm_daemon_runs
+    report = daemon.prewarm_report
+    assert report is not None and report.get("compiled", 0) >= 1, report
+    fused = [e for e in report["entries"] if e["kind"] == "fused_assign"]
+    assert fused and all(e["ok"] for e in fused), fused
+    assert all(e["width"] == 1024 and e["batch"] == 96 for e in fused)
+    # poa polish: the RNN polisher prewarm degrades to a report line
+    pol = [e for e in report["entries"] if e["kind"] == "polisher"]
+    assert pol and not pol[0]["ok"]
+
+
+def test_serve_e2e_ledger_records_warm_steady_split(warm_daemon_runs):
+    _, _, _, _, _, _, _, daemon, ledger = warm_daemon_runs
+    entries, problems = obs_history.read_entries(ledger)
+    assert problems == []
+    serve_entries = [e for e in entries if e["source"] == "serve"]
+    run_entries = [e for e in entries if e["source"] == "run"]
+    assert len(serve_entries) == 2 and len(run_entries) == 2
+    first, second = serve_entries
+    # warm-up cost rides the FIRST job's entry only; steady_s every job
+    assert first["warmup_s"] == daemon.warmup_s
+    assert "warmup_s" not in second
+    for e in serve_entries:
+        assert e["steady_s"] > 0.0
+        assert e["job_id"].startswith("job-")
+        assert e["dispatch_first_stage_s"] is not None
+        assert e["wait_s"] >= 0.0
+
+
+def test_serve_e2e_plane_disarmed_after_daemon(warm_daemon_runs):
+    assert obs_live.server() is None
+    assert obs_live._JOBS is None and obs_live._NODE_START_HOOK is None
+    assert obs_metrics.registry() is None
+    assert shutdown._ACTIVE is None
+
+
+# ---------------------------------------------------------------------------
+# e2e: drain mid-queue -> journal -> restarted daemon resumes
+
+
+@pytest.mark.slow
+def test_serve_drain_journals_and_restart_resumes(serve_library,
+                                                  tmp_path_factory):
+    """SIGTERM-equivalent drain: a cooperative stop request lands on the
+    in-flight job's coordinator (exactly what the signal handler does),
+    the job drains at its next stage boundary and is requeued with
+    resume=true, the untouched second job journals behind it, and a
+    restarted daemon runs both to byte-correct completion."""
+    from ont_tcrconsensus_tpu.graph import nodes as graph_nodes
+    from ont_tcrconsensus_tpu.pipeline.run import _read_counts_csv
+
+    src, lib = serve_library
+    base = tmp_path_factory.mktemp("serve_drain")
+    w1 = _stage(src, base / "w1")
+    w2 = _stage(src, base / "w2")
+    state = str(base / "state")
+
+    fired = threading.Event()
+    orig = graph_nodes.round1_polish
+
+    def draining_round1_polish(ctx, inputs):
+        if not fired.is_set():
+            fired.set()
+            # same path as the first SIGTERM: request() on the active
+            # (= the in-flight run's) coordinator; Preempted at the next
+            # stage boundary
+            shutdown.request("test drain")
+        return orig(ctx, inputs)
+
+    daemon = Daemon(_raw_cfg(w1), port=0, state_dir=state, do_prewarm=False)
+    loop = threading.Thread(target=daemon.serve_forever,
+                            name="serve-drain", daemon=True)
+    graph_nodes.round1_polish = draining_round1_polish
+    try:
+        loop.start()
+        srv = _wait_for_server()
+        jobs_url = f"http://127.0.0.1:{srv.port}/jobs"
+        assert _post(jobs_url, _raw_cfg(w1))[0] == 202
+        assert _post(jobs_url, _raw_cfg(w2))[0] == 202
+        # the daemon drains ITSELF after the Preempted job
+        loop.join(timeout=600.0)
+        assert not loop.is_alive(), "daemon did not drain"
+    finally:
+        graph_nodes.round1_polish = orig
+    assert fired.is_set(), "gated node never ran"
+
+    journal_file = serve_queue.journal_path(state)
+    with open(journal_file) as fh:
+        journal = json.load(fh)
+    assert len(journal["jobs"]) == 2
+    drained, untouched = journal["jobs"]
+    assert drained["state"] == "requeued"
+    # committed stages of the drained job resume, not refuse
+    assert drained["raw"]["resume"] is True
+    assert untouched["state"] == "queued"
+
+    daemon2 = Daemon(_raw_cfg(w1), port=0, state_dir=state, do_prewarm=False)
+    loop2 = threading.Thread(target=daemon2.serve_forever,
+                             name="serve-resume", daemon=True)
+    loop2.start()
+    try:
+        srv2 = _wait_for_server()
+        jobs_url2 = f"http://127.0.0.1:{srv2.port}/jobs"
+        listing: dict = {}
+        deadline = time.monotonic() + 600.0
+        while time.monotonic() < deadline:
+            st, listing = _get(jobs_url2)
+            if st == 200 and listing.get("jobs_done", 0) >= 2:
+                break
+            time.sleep(0.25)
+        assert listing.get("jobs_done") == 2, listing
+        assert all(j["state"] == "done" for j in listing["jobs"]), listing
+    finally:
+        daemon2.request_stop()
+        loop2.join(timeout=120.0)
+    assert not os.path.exists(journal_file), "journal must be consumed"
+    for w in (w1, w2):
+        counts = _read_counts_csv(str(
+            w / "fastq_pass" / "nano_tcr" / "barcode01" / "counts" /
+            "umi_consensus_counts.csv"))
+        assert counts == lib.true_counts
