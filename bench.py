@@ -515,6 +515,17 @@ def main(argv=None) -> int:
               file=sys.stderr)
         if result.status == "fail":
             rc = 1
+        # data-plane gate: host_round_trip_bytes, lower-better — a
+        # reintroduced device->host->device flow fails with measured vs
+        # allowed bytes even when the timing gate stays green
+        transfer = obs_history.evaluate_bytes_gate(
+            baseline, entry, rel_threshold=args.gate_threshold,
+            mad_k=args.gate_mad_k, min_samples=args.gate_min_samples,
+        )
+        print(f"bench: transfer gate {transfer.status.upper()} — "
+              f"{transfer.reason}", file=sys.stderr)
+        if transfer.status == "fail":
+            rc = 1
     if args.ledger:
         try:
             obs_history.append_entry(args.ledger, entry)

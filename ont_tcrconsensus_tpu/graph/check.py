@@ -245,6 +245,21 @@ def _round_trips(spec: GraphSpec, max_hops: int = 8) -> list[Finding]:
     return findings
 
 
+def round_trip_edges(spec: GraphSpec) -> set[str]:
+    """Host-placed edges that sit on any placement-round-trip path.
+
+    The measured twin of :func:`_round_trips`: the graph executor charges
+    these edges' materialized bytes to the run-level
+    ``host_round_trip_bytes`` ledger (obs/transfers.py), so the static
+    advisory and the runtime number name the same flows. Finding paths
+    alternate node, edge, node, ... — the edges sit at odd indices.
+    """
+    out: set[str] = set()
+    for f in _round_trips(spec):
+        out.update(p for i, p in enumerate(f.path) if i % 2)
+    return out
+
+
 def _reshard_sites(spec: GraphSpec) -> list[Finding]:
     out: list[Finding] = []
     for node in spec.schedule:

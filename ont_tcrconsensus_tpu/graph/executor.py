@@ -34,9 +34,11 @@ import sys
 import time
 from typing import Any
 
+from ont_tcrconsensus_tpu.graph import check as graph_check
 from ont_tcrconsensus_tpu.graph.ir import GraphSpec, Node
 from ont_tcrconsensus_tpu.obs import live as obs_live
 from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
+from ont_tcrconsensus_tpu.obs import transfers as obs_transfers
 from ont_tcrconsensus_tpu.robustness import faults, retry, watchdog
 
 
@@ -79,6 +81,9 @@ class GraphExecutor:
         self.ctx = ctx
         self.side_exec = side_exec
         self._pending: list[tuple[Node, Any]] = []
+        # host-placed edges on graftcheck's round-trip paths; filled per
+        # run() when telemetry is armed (obs/transfers.py data plane)
+        self._rt_edges: set[str] = set()
 
     def run(self, inputs: dict) -> dict:
         spec, ctx = self.spec, self.ctx
@@ -96,6 +101,12 @@ class GraphExecutor:
         # live /progress denominator: every scheduled node, before any
         # skip accounting, so done/total is stable across resume paths
         obs_live.progress_plan([n.name for n in spec.schedule])
+
+        # data-plane tap: edges whose values leave the device and come
+        # back (graftcheck's static round-trip paths) charge the
+        # run-level host_round_trip_bytes ledger as they materialize
+        self._rt_edges = (graph_check.round_trip_edges(spec)
+                          if obs_metrics.armed() else set())
 
         skip, resume_node = self._resume_scan()
         values = dict(inputs)
@@ -129,7 +140,13 @@ class GraphExecutor:
                 continue
             if node.checkpoint:
                 self._commit_pending(values, refs)
+            audit = self._donation_probe(node, values, refs)
             outputs = self._run_node(node, node_inputs, units)
+            if audit:
+                out_probe = obs_transfers.buffer_probe(outputs)
+                for e, probe in audit.items():
+                    obs_transfers.audit_donation(e, node.name, probe,
+                                                 out_probe)
             self._absorb(node, outputs, values, refs)
         self._commit_pending(values, refs)
         return {e: values[e] for e in spec.results}
@@ -158,6 +175,24 @@ class GraphExecutor:
                 return closure, node
         return set(), None
 
+    def _donation_probe(self, node: Node, values: dict,
+                        refs: dict[str, int]) -> dict:
+        """Buffer-identity probes for this node's hbm inputs at their
+        drop point (live ref count 1: this node is the last consumer —
+        the same eligibility rule graftcheck derives statically), taken
+        BEFORE the node runs so a donated-then-reused pointer is still
+        readable. Empty when telemetry is off."""
+        if not obs_metrics.armed():
+            return {}
+        spec = self.spec
+        return {
+            e: obs_transfers.buffer_probe(values.get(e))
+            for e in node.inputs
+            if (refs.get(e, 0) == 1 and e in spec.edges
+                and spec.edges[e].placement == "hbm"
+                and e not in spec.results)
+        }
+
     def _run_node(self, node: Node, inputs: dict, units: int) -> dict:
         ctx = self.ctx
         t0 = time.monotonic()
@@ -173,6 +208,9 @@ class GraphExecutor:
             dt = time.monotonic() - t0
             obs_metrics.graph_node_add(node.name, critical_s=dt)
             obs_live.progress_node_finish(node.name, dt, units=units)
+            # node-boundary HBM sample for the --report --memory
+            # reconciler (no-op off-telemetry / without memory stats)
+            obs_transfers.node_hbm_boundary(node.name)
         return outputs
 
     def _commit_pending(self, values: dict, refs: dict[str, int]) -> None:
@@ -221,6 +259,12 @@ class GraphExecutor:
                 f"declared {sorted(want)}"
             )
         values.update(outputs)
+        if obs_metrics.armed():
+            for e, v in outputs.items():
+                if e in self.spec.edges:
+                    obs_transfers.edge_materialized(
+                        e, self.spec.edges[e].placement, v,
+                        round_trip=e in self._rt_edges)
         for e in node.inputs:
             refs[e] = refs.get(e, 1) - 1
             if refs[e] <= 0 and e not in self.spec.results:

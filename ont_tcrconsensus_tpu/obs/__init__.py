@@ -104,6 +104,13 @@ OBS_SITES = frozenset({
     "serve.first_stage_s",
     "serve.job",
     "serve.drain",
+    # --- device data-plane ledger (obs/transfers.py: transfer plants at
+    # the device boundary, donation-audit and HBM-reconcile sample
+    # counters via metrics.counter_add) ---
+    "transfer.h2d",
+    "transfer.d2h",
+    "donation.audit",
+    "memory.reconcile",
 })
 
 KNOWN_SITES = OBS_SITES

@@ -40,7 +40,7 @@ import resource
 import threading
 import time
 
-from ont_tcrconsensus_tpu.obs import metrics, trace
+from ont_tcrconsensus_tpu.obs import metrics, trace, transfers
 
 _tls = threading.local()
 
@@ -116,6 +116,9 @@ def timed_get(site: str, value):
     else:
         reg.dispatch_add(site, gets=1, block_s=dt,
                          stage=trace.current_label())
+    # every instrumented readback also feeds the transfer ledger: the
+    # host copy that just materialized is exactly the d2h payload
+    transfers.d2h(site, out)
     return out
 
 

@@ -180,6 +180,29 @@ def build_entry(source: str, telemetry: dict | None = None, *,
         analysis = telemetry.get("analysis") or {}
         if isinstance(analysis, dict) and "graftcheck" in analysis:
             entry["graftcheck"] = analysis["graftcheck"]
+        # device data-plane roll-up (additive): total h2d/d2h bytes, the
+        # round-trip budget the transfer gate holds the line on, and the
+        # per-edge donation verdicts — pre-upgrade entries simply lack
+        # these keys and stay valid baselines (evaluate_bytes_gate warns)
+        transfers = telemetry.get("transfers")
+        if isinstance(transfers, dict):
+            sites = transfers.get("sites")
+            if isinstance(sites, dict):
+                entry["transfer_bytes"] = {
+                    "h2d": sum(s.get("h2d_bytes", 0) for s in sites.values()
+                               if isinstance(s, dict)),
+                    "d2h": sum(s.get("d2h_bytes", 0) for s in sites.values()
+                               if isinstance(s, dict)),
+                }
+            hrt = transfers.get("host_round_trip_bytes")
+            if isinstance(hrt, (int, float)) and not isinstance(hrt, bool):
+                entry["host_round_trip_bytes"] = int(hrt)
+            donation = transfers.get("donation")
+            if isinstance(donation, dict) and donation:
+                entry["donation"] = {
+                    k: v.get("verdict") for k, v in sorted(donation.items())
+                    if isinstance(v, dict)
+                }
         # executed-graph per-node seconds (additive): the stage roll-up
         # above loses the executor's critical/overlapped attribution, so
         # the critical-path analyzer and the live plane's /progress ETA
@@ -350,6 +373,71 @@ def evaluate_gate(entries: list[dict], current: dict, *,
         )
     return GateResult(
         "pass", f"within noise allowance: {detail}", metric=mname,
+        current=cur, baseline_median=med, baseline_mad=mad,
+        allowance=allowance, n_baseline=len(values),
+    )
+
+
+def _bytes_of(entry: dict, metric: str) -> float | None:
+    """A byte metric of one entry; unlike :func:`_metric_of`, zero is a
+    valid (ideal) value — a baseline of 0 round-trip bytes must gate."""
+    v = entry.get(metric)
+    if isinstance(v, (int, float)) and not isinstance(v, bool) and v >= 0:
+        return float(v)
+    return None
+
+
+def evaluate_bytes_gate(entries: list[dict], current: dict, *,
+                        metric: str = "host_round_trip_bytes",
+                        rel_threshold: float = 0.15, mad_k: float = 4.0,
+                        min_samples: int = 3) -> GateResult:
+    """Lower-is-better byte gate over a ledger byte metric (the data-plane
+    twin of :func:`evaluate_gate`; same median+MAD allowance).
+
+    Pre-upgrade ledger entries simply lack the byte fields: they are
+    skipped (never a crash), and a pool left thinner than ``min_samples``
+    degrades to ``warn`` — a legacy ledger stays a valid baseline for the
+    timing gate without blocking CI on the new metric. The fail reason
+    carries measured vs allowed bytes, so a reintroduced host round-trip
+    is a sized finding.
+    """
+    cur = _bytes_of(current, metric)
+    if cur is None:
+        return GateResult(
+            "warn", f"current entry has no {metric} field (pre-upgrade "
+            "telemetry or telemetry off) — not gated", metric=metric,
+        )
+    pool = matching_entries(entries, current)
+    values = [v for e in pool
+              for v in (_bytes_of(e, metric),) if v is not None]
+    legacy = len(pool) - len(values)
+    if len(values) < min_samples:
+        return GateResult(
+            "warn",
+            f"thin ledger: {len(values)} matching baseline sample(s) with "
+            f"{metric} < min_samples={min_samples}"
+            + (f" ({legacy} legacy entrie(s) without the field skipped)"
+               if legacy else "")
+            + " — recorded, not gated",
+            metric=metric, current=cur, n_baseline=len(values),
+        )
+    med = _median(values)
+    mad = _median([abs(v - med) for v in values])
+    allowance = max(rel_threshold * med, mad_k * MAD_SCALE * mad)
+    allowed = med + allowance
+    detail = (f"{metric}={cur:.0f} B vs allowed {allowed:.0f} B "
+              f"(baseline median {med:.0f} B, MAD {mad:.0f}, allowance "
+              f"{allowance:.0f} B, {len(values)} sample(s)"
+              + (f", {legacy} legacy skipped" if legacy else "") + ")")
+    if cur > allowed:
+        return GateResult(
+            "fail", f"data-plane regression: {detail} — "
+            f"{cur - allowed:.0f} B of new host round-trip traffic",
+            metric=metric, current=cur, baseline_median=med,
+            baseline_mad=mad, allowance=allowance, n_baseline=len(values),
+        )
+    return GateResult(
+        "pass", f"within byte allowance: {detail}", metric=metric,
         current=cur, baseline_median=med, baseline_mad=mad,
         allowance=allowance, n_baseline=len(values),
     )

@@ -65,6 +65,24 @@ class MetricsRegistry:
         # dispatch-tax split re-keyed by the active stage span, so the
         # per-node rollup needs no trace replay)
         self.dispatch_stages: dict[str, list[float]] = {}
+        # --- device data-plane ledger (fed by obs/transfers.py) ---
+        # site -> [h2d_bytes, h2d_count, d2h_bytes, d2h_count]
+        self.transfers: dict[str, list[float]] = {}
+        # graph edge -> [bytes, count, direction, placement]
+        self.edge_transfers: dict[str, list] = {}
+        # graph edge -> {"verdict": donated|copied|unknown, "node": str}
+        self.donations: dict[str, dict] = {}
+        # graph node -> [delta_bytes_sum, end_bytes_max, samples]
+        self.node_hbm: dict[str, list[float]] = {}
+        # graph node -> graftcheck static live-HBM estimate (max over
+        # libraries; recorded at run start so --report reconciles from
+        # the artifact alone)
+        self.static_hbm: dict[str, float] = {}
+        # [bytes, last_sample] — bytes that left the device and came
+        # back (graftcheck round-trip edges); list so the lock rule sees
+        # mutation, not rebinding
+        self._round_trip = [0.0]
+        self._hbm_prev: float | None = None
 
     # --- update API (called via the module-level wrappers) -----------------
 
@@ -162,6 +180,52 @@ class MetricsRegistry:
         with self._lock:
             self.analysis[name] = dict(summary)
 
+    # --- device data-plane ledger (obs/transfers.py) -----------------------
+
+    def transfer_add(self, site: str, direction: str, nbytes: int,
+                     n: int = 1) -> None:
+        with self._lock:
+            t = self.transfers.setdefault(site, [0, 0, 0, 0])
+            i = 0 if direction == "h2d" else 2
+            t[i] += nbytes
+            t[i + 1] += n
+
+    def edge_transfer_add(self, edge: str, direction: str, nbytes: int,
+                          placement: str) -> None:
+        with self._lock:
+            e = self.edge_transfers.setdefault(
+                edge, [0, 0, direction, placement])
+            e[0] += nbytes
+            e[1] += 1
+
+    def donation_set(self, edge: str, verdict: str, node: str) -> None:
+        with self._lock:
+            # a single "copied" sighting must survive later "donated"
+            # materializations of the same edge — the regression is the
+            # finding, not the steady state
+            prev = self.donations.get(edge)
+            if prev is None or prev["verdict"] != "copied":
+                self.donations[edge] = {"verdict": verdict, "node": node}
+
+    def node_hbm_add(self, node: str, end_bytes: float) -> None:
+        with self._lock:
+            prev = self._hbm_prev
+            self._hbm_prev = end_bytes
+            h = self.node_hbm.setdefault(node, [0.0, 0.0, 0])
+            if prev is not None:
+                h[0] += end_bytes - prev
+            h[1] = max(h[1], end_bytes)
+            h[2] += 1
+
+    def static_hbm_set(self, node: str, bytes_est: float) -> None:
+        with self._lock:
+            if bytes_est > self.static_hbm.get(node, float("-inf")):
+                self.static_hbm[node] = bytes_est
+
+    def round_trip_add(self, nbytes: int) -> None:
+        with self._lock:
+            self._round_trip[0] += nbytes
+
     # --- roll-up -----------------------------------------------------------
 
     def summary(self) -> dict:
@@ -200,6 +264,37 @@ class MetricsRegistry:
                     for k, v in sorted(self.hists.items())
                 },
             }
+            # data-plane ledger: always present when armed, so a
+            # --report --memory over any post-upgrade artifact can tell
+            # "nothing moved" apart from "nothing was measured"
+            transfers: dict = {
+                "sites": {
+                    k: {"h2d_bytes": int(v[0]), "h2d": int(v[1]),
+                        "d2h_bytes": int(v[2]), "d2h": int(v[3])}
+                    for k, v in sorted(self.transfers.items())
+                },
+                "edges": {
+                    k: {"bytes": int(v[0]), "count": int(v[1]),
+                        "direction": v[2], "placement": v[3]}
+                    for k, v in sorted(self.edge_transfers.items())
+                },
+                "host_round_trip_bytes": int(self._round_trip[0]),
+            }
+            if self.donations:
+                transfers["donation"] = {
+                    k: dict(self.donations[k]) for k in sorted(self.donations)
+                }
+            if self.node_hbm:
+                transfers["node_hbm"] = {
+                    k: {"delta_bytes": int(v[0]), "end_bytes": int(v[1]),
+                        "samples": int(v[2])}
+                    for k, v in sorted(self.node_hbm.items())
+                }
+            if self.static_hbm:
+                transfers["static_hbm_by_node"] = {
+                    k: int(self.static_hbm[k]) for k in sorted(self.static_hbm)
+                }
+            out["transfers"] = transfers
             if self.dispatch_stages:
                 out["dispatch_by_stage"] = {
                     k: {"dispatches": int(v[0]), "gets": int(v[1]),
@@ -348,6 +443,44 @@ class MetricsRegistry:
             fam(lines, "tcr_graph_node_skips_total", "counter",
                 "Per-node resume-skip counts.",
                 [("node", k, self.graph_nodes[k][3]) for k in gnodes])
+            # data-plane families: the edge family carries two labels
+            # (edge + direction), so it's rendered by hand — fam() is
+            # the single-label helper
+            if self.transfers:
+                lines.append("# HELP tcr_transfer_site_bytes_total Per-site "
+                             "host<->device transfer bytes.")
+                lines.append("# TYPE tcr_transfer_site_bytes_total counter")
+                for k in sorted(self.transfers):
+                    v = self.transfers[k]
+                    for direction, b in (("h2d", v[0]), ("d2h", v[2])):
+                        if b:
+                            lines.append(
+                                f'tcr_transfer_site_bytes_total'
+                                f'{{site="{prom_label(k)}",'
+                                f'direction="{direction}"}} {b:g}')
+            if self.edge_transfers:
+                lines.append("# HELP tcr_transfer_bytes_total Per-graph-edge "
+                             "materialized bytes by direction.")
+                lines.append("# TYPE tcr_transfer_bytes_total counter")
+                for k in sorted(self.edge_transfers):
+                    v = self.edge_transfers[k]
+                    lines.append(
+                        f'tcr_transfer_bytes_total{{edge="{prom_label(k)}",'
+                        f'direction="{prom_label(v[2])}"}} {v[0]:g}')
+                lines.append("# HELP tcr_host_round_trip_bytes_total Bytes "
+                             "that left the device and came back (graftcheck "
+                             "round-trip edges).")
+                lines.append("# TYPE tcr_host_round_trip_bytes_total counter")
+                lines.append(
+                    f"tcr_host_round_trip_bytes_total {self._round_trip[0]:g}")
+            hnodes = sorted(self.node_hbm)
+            fam(lines, "tcr_node_hbm_delta_bytes", "gauge",
+                "Per-node measured HBM delta (bytes-in-use change across "
+                "the node's executions).",
+                [("node", k, self.node_hbm[k][0]) for k in hnodes])
+            fam(lines, "tcr_node_hbm_end_bytes", "gauge",
+                "Per-node measured HBM high-water at node exit.",
+                [("node", k, self.node_hbm[k][1]) for k in hnodes])
             return lines
 
 
@@ -374,6 +507,12 @@ LOCK_OWNERSHIP = {
     "MetricsRegistry.graph_meta": "_lock",
     "MetricsRegistry.pools": "_lock",
     "MetricsRegistry.analysis": "_lock",
+    "MetricsRegistry.transfers": "_lock",
+    "MetricsRegistry.edge_transfers": "_lock",
+    "MetricsRegistry.donations": "_lock",
+    "MetricsRegistry.node_hbm": "_lock",
+    "MetricsRegistry.static_hbm": "_lock",
+    "MetricsRegistry._round_trip": "_lock",
 }
 
 

@@ -20,6 +20,7 @@ import os
 from ont_tcrconsensus_tpu.obs import critical_path as critical_path_mod
 from ont_tcrconsensus_tpu.obs import history as history_mod
 from ont_tcrconsensus_tpu.obs import metrics, trace
+from ont_tcrconsensus_tpu.obs import transfers as transfers_mod
 
 TELEMETRY_BASENAME = "telemetry.json"
 TRACE_RELPATH = os.path.join("logs", "trace.json")
@@ -148,6 +149,32 @@ def _render_telemetry(data: dict, lines: list[str]) -> None:
         f"{_fmt_bytes(gauges.get('device.hbm_bytes_in_use'))}, "
         f"peak host RSS {_fmt_bytes(gauges.get('host.rss_bytes'))}"
     )
+    transfers = data.get("transfers")
+    if transfers is not None:
+        # strict indexing on purpose: a garbage transfers section raises
+        # into the malformed-artifact handler like every other section
+        sites = transfers.get("sites") or {}
+        h2d_b = sum(s["h2d_bytes"] for s in sites.values())
+        d2h_b = sum(s["d2h_bytes"] for s in sites.values())
+        lines.append(
+            f"data plane: h2d {_fmt_bytes(h2d_b)}, d2h {_fmt_bytes(d2h_b)} "
+            f"across {len(sites)} site(s); host round-trip "
+            f"{_fmt_bytes(transfers['host_round_trip_bytes'])}"
+        )
+        edges = transfers.get("edges") or {}
+        for name, e in list(edges.items())[:12]:
+            lines.append(
+                f"  edge {name:24s} {e['direction']}[{e['placement']}] "
+                f"{_fmt_bytes(e['bytes'])} over {e['count']} "
+                "materialization(s)"
+            )
+        donation = transfers.get("donation") or {}
+        if donation:
+            counts: dict[str, int] = {}
+            for d in donation.values():
+                counts[d["verdict"]] = counts.get(d["verdict"], 0) + 1
+            lines.append("donation verdicts: " + ", ".join(
+                f"{k}={counts[k]}" for k in sorted(counts)))
     rob = data.get("robustness_events", {})
     if rob:
         lines.append("robustness events: " + ", ".join(
@@ -180,13 +207,16 @@ def _render_flight_recorder(base: str, rec: dict, lines: list[str]) -> None:
         )
 
 
-def render_report(nano_dir: str, critical_path: bool = False) -> tuple[str, int]:
+def render_report(nano_dir: str, critical_path: bool = False,
+                  memory: bool = False) -> tuple[str, int]:
     """(report text, exit code) from the committed artifacts in
     ``nano_dir``. Exit 1 when no telemetry artifact exists. With
     ``critical_path``, each telemetry artifact's executed-graph section is
     additionally run through :mod:`obs.critical_path` (slack / what-if /
     pool efficiency; analysis problems are informational — they name what
-    the artifact cannot support, without failing the report)."""
+    the artifact cannot support, without failing the report). ``memory``
+    adds the static-vs-measured HBM reconciliation
+    (:func:`obs.transfers.analyze_memory`) under the same contract."""
     lines = [f"run report: {nano_dir}"]
     tele_paths = sorted(glob.glob(os.path.join(nano_dir, "telemetry*.json")))
     tele_paths = [p for p in tele_paths if not p.endswith(".tmp")]
@@ -247,6 +277,10 @@ def render_report(nano_dir: str, critical_path: bool = False) -> tuple[str, int]
             lines.append("-- critical path --")
             critical_path_mod.render(
                 critical_path_mod.analyze(data, trace_payload), lines)
+        if memory:
+            lines.append("-- memory reconciliation --")
+            transfers_mod.render_memory(
+                transfers_mod.analyze_memory(data), lines)
     for rpath in sorted(glob.glob(
         os.path.join(nano_dir, "robustness_report*.json")
     )):
@@ -298,8 +332,8 @@ def render_report(nano_dir: str, critical_path: bool = False) -> tuple[str, int]
     return "\n".join(lines) + "\n", rc
 
 
-def collect_report(nano_dir: str, critical_path: bool = False
-                   ) -> tuple[dict, int]:
+def collect_report(nano_dir: str, critical_path: bool = False,
+                   memory: bool = False) -> tuple[dict, int]:
     """Machine-readable twin of :func:`render_report` (``--report --json``).
 
     Same resolution rules and exit codes: each telemetry artifact is
@@ -319,6 +353,8 @@ def collect_report(nano_dir: str, critical_path: bool = False
         rc = 1
     if critical_path:
         out["critical_path"] = {}
+    if memory:
+        out["memory"] = {}
     for path in tele_paths:
         base = os.path.basename(path)
         try:
@@ -356,6 +392,8 @@ def collect_report(nano_dir: str, critical_path: bool = False
         if critical_path:
             out["critical_path"][base] = critical_path_mod.analyze(
                 data, trace_payload)
+        if memory:
+            out["memory"][base] = transfers_mod.analyze_memory(data)
     robustness: dict = {}
     for rpath in sorted(glob.glob(
         os.path.join(nano_dir, "robustness_report*.json")
@@ -406,7 +444,7 @@ def collect_report(nano_dir: str, critical_path: bool = False
 
 
 def report_main(target: str, as_json: bool = False,
-                critical_path: bool = False) -> int:
+                critical_path: bool = False, memory: bool = False) -> int:
     """CLI body for ``tcr-consensus-tpu --report <workdir>``."""
     import sys
 
@@ -420,10 +458,11 @@ def report_main(target: str, as_json: bool = False,
             sys.stdout.write("\n")
         return 2
     if as_json:
-        data, rc = collect_report(nano, critical_path=critical_path)
+        data, rc = collect_report(nano, critical_path=critical_path,
+                                  memory=memory)
         json.dump(data, sys.stdout, indent=1)
         sys.stdout.write("\n")
         return rc
-    text, rc = render_report(nano, critical_path=critical_path)
+    text, rc = render_report(nano, critical_path=critical_path, memory=memory)
     sys.stdout.write(text)
     return rc

@@ -27,6 +27,8 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ont_tcrconsensus_tpu.obs import transfers as obs_transfers
+
 
 def make_mesh(shape: dict[str, int] | None = None, devices=None) -> Mesh:
     """Build a mesh; default puts every device on the data axis.
@@ -94,6 +96,7 @@ def shard_batch(mesh: Mesh, *arrays):
     out = []
     for a in arrays:
         out.append(jax.device_put(a, data_sharding(mesh, np.ndim(a))))
+    obs_transfers.h2d("transfer.h2d", arrays)
     return tuple(out) if len(out) > 1 else out[0]
 
 
@@ -128,9 +131,12 @@ def sharded_train_step(mesh: Mesh, optimizer):
     base_step = polisher_mod.make_train_step(optimizer)
 
     def place_params(params):
-        return jax.device_put(params, polisher_param_sharding(mesh, params))
+        placed = jax.device_put(params, polisher_param_sharding(mesh, params))
+        obs_transfers.h2d("transfer.h2d", jax.tree_util.tree_leaves(params))
+        return placed
 
     def place_batch(feats, labels, ins_labels, mask):
+        obs_transfers.h2d("transfer.h2d", (feats, labels, ins_labels, mask))
         return (
             jax.device_put(feats, data_sharding(mesh, 3)),
             jax.device_put(labels, data_sharding(mesh, 2)),

@@ -88,6 +88,14 @@ def main(argv: list[str] | None = None) -> int:
         "per-node dispatch tax, overlap-pool efficiency.",
     )
     parser.add_argument(
+        "--memory", action="store_true",
+        help="With --report: reconcile graftcheck's static per-node HBM "
+        "liveness against the measured node-boundary samples in "
+        "telemetry.json's transfers section — per-node static vs "
+        "measured bytes, donation verdicts, host round-trip bytes; "
+        "divergence beyond threshold is a named problem.",
+    )
+    parser.add_argument(
         "--live-port", type=int, default=None, metavar="PORT",
         help="Arm the live observability plane for this run (overrides the "
         "live_port config knob): read-only /healthz, /metrics (Prometheus "
@@ -113,6 +121,8 @@ def main(argv: list[str] | None = None) -> int:
         parser.error("--json is a --report/--validate option")
     if args.critical_path and not args.report:
         parser.error("--critical-path is a --report option")
+    if args.memory and not args.report:
+        parser.error("--memory is a --report option")
     if args.live_port is not None and (args.report or args.validate):
         parser.error("--live-port is a run option (it arms a live endpoint "
                      "for the run's duration; --report/--validate exit "
@@ -124,7 +134,7 @@ def main(argv: list[str] | None = None) -> int:
 
         return report_mod.report_main(
             args.json_config_file, as_json=args.json,
-            critical_path=args.critical_path,
+            critical_path=args.critical_path, memory=args.memory,
         )
 
     if args.validate:

@@ -658,10 +658,16 @@ def _run_library_graph(fastq, lay, cfg, panel, engine, engine_notrim,
         # runtime numbers they predict. Never takes down a run.
         from ont_tcrconsensus_tpu.graph import check as graph_check
         from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
+        from ont_tcrconsensus_tpu.obs import transfers as obs_transfers
 
         report = graph_check.analyze(
             spec, graph_check.production_byte_model(cfg))
         obs_metrics.analysis_set("graftcheck", report.summary())
+        # static per-node live-HBM into the registry NOW, so --report
+        # --memory reconciles from the committed artifact alone (no
+        # config, no jax) against the executor's boundary samples
+        for step in report.liveness:
+            obs_transfers.static_hbm(step["node"], step["hbm_bytes_est"])
     except Exception as exc:
         _log(f"WARNING: graftcheck analysis failed: {exc!r}")
     executor = graph_exec.GraphExecutor(spec, ctx, side_exec=qc_exec)

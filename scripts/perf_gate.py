@@ -16,7 +16,12 @@ records instead of failing; see README "Cross-run observability").
 
 Metric: ``reads_per_sec`` (higher is better; bench entries) when the
 current entry carries one, else ``duration_s`` (lower is better; run
-entries).
+entries). A second, independent verdict gates the data plane:
+``host_round_trip_bytes`` (lower is better; obs/transfers.py ledger),
+so a PR that reintroduces a host round-trip fails with measured vs
+allowed bytes. Entries predating the transfer ledger simply lack the
+field — they are skipped for the byte pool (WARN when it goes thin,
+never a crash) while remaining full baselines for the timing gate.
 
 Usage:
     python scripts/perf_gate.py LEDGER.jsonl [--current latest|entry.json]
@@ -92,11 +97,21 @@ def main(argv: list[str] | None = None) -> int:
         entries, current, rel_threshold=args.threshold,
         mad_k=args.mad_k, min_samples=args.min_samples,
     )
+    transfer = history.evaluate_bytes_gate(
+        entries, current, rel_threshold=args.threshold,
+        mad_k=args.mad_k, min_samples=args.min_samples,
+    )
     if args.json:
-        print(json.dumps(dataclasses.asdict(result), sort_keys=True))
+        # one JSON object on stdout (consumers json.loads the whole
+        # stream); the transfer verdict rides an additive key
+        body = dataclasses.asdict(result)
+        body["transfer"] = dataclasses.asdict(transfer)
+        print(json.dumps(body, sort_keys=True))
     else:
         print(f"perf_gate: {result.status.upper()} — {result.reason}")
-    return 1 if result.status == "fail" else 0
+        print(f"perf_gate: transfer {transfer.status.upper()} — "
+              f"{transfer.reason}")
+    return 1 if "fail" in (result.status, transfer.status) else 0
 
 
 if __name__ == "__main__":

@@ -117,8 +117,9 @@ if [ "${1:-}" != "--no-chaos" ]; then
 fi
 
 echo "--- telemetry smoke (tiny run at telemetry=full: telemetry.json +"
-echo "    trace.json exist and validate; counts/consensus byte-identical"
-echo "    to a telemetry=off run) ---"
+echo "    trace.json exist and validate, incl. the transfers section —"
+echo "    per-edge ledger, donation verdicts, static HBM; counts/consensus"
+echo "    byte-identical to a telemetry=off run) ---"
 timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q \
     -k "telemetry_full_e2e_artifacts or telemetry_off_is_byte_identical" \
     -p no:cacheprovider -p no:xdist -p no:randomly

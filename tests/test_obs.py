@@ -344,6 +344,24 @@ def test_telemetry_full_e2e_artifacts(telemetry_runs):
     pool = tele["graph"]["pool"]
     assert pool["slots"] >= 1 and pool["busy_s"] >= 0.0
     assert pool["idle_s"] >= 0.0 and pool["window_s"] >= 0.0
+    # device data-plane ledger (obs/transfers.py): every telemetry-armed
+    # run commits a transfers section — per-site and per-edge bytes, the
+    # run-level round-trip budget, donation verdicts from the executor's
+    # drop-point audit, and graftcheck's static per-node HBM estimates
+    tr = tele["transfers"]
+    assert tr["sites"], "instrumented device_put/get sites must record"
+    assert all(s["d2h_bytes"] >= 0 and s["h2d_bytes"] >= 0
+               for s in tr["sites"].values())
+    assert tr["edges"], "executor edge materialization must be attributed"
+    assert all(e["direction"] in ("h2d", "d2h") for e in tr["edges"].values())
+    assert isinstance(tr["host_round_trip_bytes"], int)
+    assert tr["host_round_trip_bytes"] >= 0
+    verdicts = {d["verdict"] for d in tr.get("donation", {}).values()}
+    assert verdicts <= {"donated", "copied", "unknown"}
+    assert tr["static_hbm_by_node"], "graftcheck liveness must be recorded"
+    # and the history entry carries the roll-up for bench.py --gate
+    assert entries[0]["transfer_bytes"]["d2h"] >= 0
+    assert entries[0]["host_round_trip_bytes"] == tr["host_round_trip_bytes"]
 
 
 def test_telemetry_off_is_byte_identical_and_artifact_free(telemetry_runs):

@@ -1,0 +1,456 @@
+"""Device data-plane auditor (obs/transfers.py): byte-accounting units,
+donation-verdict logic, the executor's ledger tap on synthetic graphs,
+the --report --memory reconciler with its never-crash garbage ladder,
+and the host_round_trip_bytes gate (library + perf_gate CLI on a mixed
+legacy/upgraded ledger).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import subprocess
+import sys
+from pathlib import Path
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from ont_tcrconsensus_tpu.graph import check as graph_check
+from ont_tcrconsensus_tpu.graph.executor import GraphExecutor
+from ont_tcrconsensus_tpu.graph.ir import GraphBuilder
+from ont_tcrconsensus_tpu.obs import history
+from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
+from ont_tcrconsensus_tpu.obs import report as obs_report
+from ont_tcrconsensus_tpu.obs import transfers
+from ont_tcrconsensus_tpu.qc.timing import StageTimer
+
+PERF_GATE = Path(__file__).resolve().parents[1] / "scripts" / "perf_gate.py"
+
+# fixture node/edge names in variables, keeping the literal-scoped lint
+# rules (graph-unknown-node / obs-unknown-site) out of test graphs
+N_DEV1, N_DEV2 = "t-dev1", "t-dev2"
+S_SITE = "t-site"
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    obs_metrics.disarm()
+
+
+def _ctx():
+    return SimpleNamespace(cfg=SimpleNamespace(resume=False),
+                           timer=StageTimer(), lay=None)
+
+
+# ---------------------------------------------------------------------------
+# byte accounting
+
+
+@dataclasses.dataclass
+class _Block:
+    codes: np.ndarray
+    names: list
+
+
+def test_nbytes_of_arrays_containers_and_dataclasses():
+    a = np.zeros((4, 8), np.int8)
+    assert transfers.nbytes_of(a) == 32
+    assert transfers.nbytes_of({"x": a, "y": [a, a]}) == 96
+    assert transfers.nbytes_of((b"abcd", "ef")) == 6
+    blk = _Block(codes=np.zeros(16, np.int8), names=["aa", "bb"])
+    assert transfers.nbytes_of(blk) == 16 + 4
+    assert transfers.nbytes_of(None) == 0
+    assert transfers.nbytes_of(object()) == 0  # unknown leaf: count 0
+
+
+def test_nbytes_of_never_consumes_iterators():
+    """A generator edge value must survive being measured — consuming it
+    here would corrupt the pipeline the ledger audits."""
+    gen = (i for i in range(5))
+    assert transfers.nbytes_of(gen) == 0
+    assert list(gen) == [0, 1, 2, 3, 4]
+
+
+# ---------------------------------------------------------------------------
+# donation verdict logic (pure)
+
+
+def test_donation_verdict_ladder():
+    dev = ({10, 11}, True)
+    cpu = ({10}, False)
+    assert transfers.donation_verdict(None, dev) == "unknown"
+    assert transfers.donation_verdict(cpu, ({10}, False)) == "unknown"
+    assert transfers.donation_verdict(dev, ({11, 99}, True)) == "donated"
+    assert transfers.donation_verdict(dev, ({98, 99}, True)) == "copied"
+    assert transfers.donation_verdict(dev, None) == "copied"
+
+
+# ---------------------------------------------------------------------------
+# ledger plants + registry roll-up
+
+
+def test_ledger_sites_rollup_and_prometheus():
+    reg = obs_metrics.arm()
+    transfers.h2d(S_SITE, np.zeros(100, np.int8))
+    transfers.h2d(S_SITE, None, nbytes=50)
+    transfers.d2h(S_SITE, np.zeros(25, np.int8))
+    tr = reg.summary()["transfers"]
+    assert tr["sites"][S_SITE] == {
+        "h2d_bytes": 150, "h2d": 2, "d2h_bytes": 25, "d2h": 1}
+    assert tr["host_round_trip_bytes"] == 0
+    text = "\n".join(reg.prometheus_lines())
+    assert ('tcr_transfer_site_bytes_total{site="t-site",direction="h2d"} '
+            "150") in text
+    # an armed-but-idle registry emits no transfer families at all (the
+    # exposition stays valid, families only appear once fed)
+    assert "tcr_transfer" not in "\n".join(obs_metrics.arm()
+                                           .prometheus_lines())
+
+
+def test_plants_are_noops_when_disarmed():
+    obs_metrics.disarm()
+    transfers.h2d(S_SITE, np.zeros(8))
+    transfers.d2h(S_SITE, np.zeros(8))
+    transfers.edge_materialized("e", "hbm", np.zeros(8))
+    transfers.audit_donation("e", "n", None, None)
+    transfers.node_hbm_boundary("n")
+    transfers.static_hbm("n", 100)
+    assert obs_metrics.registry() is None
+
+
+# ---------------------------------------------------------------------------
+# the executor tap: per-edge attribution, round-trip charge, donation audit
+
+
+def _round_trip_graph() -> GraphBuilder:
+    """dev1 -> h(host) -> dev2: the host edge sits between two device
+    nodes, so graftcheck flags it as a round-trip and the executor must
+    charge its bytes to host_round_trip_bytes."""
+    b = GraphBuilder("t")
+    b.input("src", "disk")
+    b.edge("x", "hbm")
+    b.edge("h", "host")
+    b.edge("out", "host")
+    b.add_node(N_DEV1, lambda ctx, i: {"x": i["src"] * 2, "h": i["src"] + 1},
+               inputs=("src",), outputs=("x", "h"))
+    b.add_node(N_DEV2, lambda ctx, i: {"out": i["x"] + i["h"]},
+               inputs=("x", "h"), outputs=("out",))
+    b.result("out")
+    return b
+
+
+def test_round_trip_edges_matches_static_findings():
+    spec = _round_trip_graph().build()
+    assert graph_check.round_trip_edges(spec) == {"h"}
+
+
+def test_executor_tap_attributes_edges_and_charges_round_trip():
+    spec = _round_trip_graph().build()
+    reg = obs_metrics.arm()
+    src = np.ones(100, np.int8)
+    out = GraphExecutor(spec, _ctx()).run({"src": src})
+    assert out["out"].shape == (100,)
+    tr = reg.summary()["transfers"]
+    assert tr["edges"]["x"] == {"bytes": 100, "count": 1,
+                                "direction": "h2d", "placement": "hbm"}
+    assert tr["edges"]["h"]["direction"] == "d2h"
+    # only the round-trip edge h is charged to the run-level budget
+    assert tr["host_round_trip_bytes"] == 100
+    # x is donation-eligible (hbm, dropped at dev2); numpy buffers carry
+    # no unsafe_buffer_pointer, so the verdict degrades to unknown
+    assert tr["donation"]["x"] == {"verdict": "unknown", "node": N_DEV2}
+
+
+def test_executor_tap_is_inert_when_disarmed():
+    spec = _round_trip_graph().build()
+    out = GraphExecutor(spec, _ctx()).run({"src": np.ones(10, np.int8)})
+    assert out["out"].shape == (10,)
+    assert obs_metrics.registry() is None
+
+
+# ---------------------------------------------------------------------------
+# the reconciler (jax-free) + its garbage ladder
+
+
+def _artifact(**transfers_over) -> dict:
+    tr = {
+        "sites": {}, "edges": {}, "host_round_trip_bytes": 0,
+        "static_hbm_by_node": {"round1_polish": 4000},
+        "node_hbm": {"round1_polish": {"delta_bytes": 64, "end_bytes": 4100,
+                                       "samples": 2}},
+    }
+    tr.update(transfers_over)
+    return {"telemetry": "on", "duration_s": 1.0, "transfers": tr}
+
+
+def test_analyze_memory_reconciles_and_flags_divergence():
+    a = transfers.analyze_memory(_artifact())
+    row = a["nodes"]["round1_polish"]
+    assert row["static_bytes"] == 4000 and row["measured_end_bytes"] == 4100
+    assert abs(row["divergence"] - 0.025) < 1e-9
+    assert a["problems"] == []
+    # beyond threshold -> named problem with both numbers
+    a = transfers.analyze_memory(_artifact(
+        node_hbm={"round1_polish": {"end_bytes": 9000, "delta_bytes": 0,
+                                    "samples": 1}}))
+    assert any("hbm divergence at node round1_polish" in p
+               and "4000" in p and "9000" in p for p in a["problems"])
+
+
+def test_analyze_memory_names_copied_donations():
+    a = transfers.analyze_memory(_artifact(
+        donation={"read_store": {"verdict": "copied",
+                                 "node": "round1_polish"}}))
+    assert a["donation"] == {"copied": 1}
+    assert any("donation regression" in p and "read_store" in p
+               for p in a["problems"])
+
+
+def test_analyze_memory_garbage_ladder():
+    # pre-upgrade artifact / telemetry off
+    a = transfers.analyze_memory({"duration_s": 1.0})
+    assert any("no transfers section" in p for p in a["problems"])
+    # transfers is valid JSON but not an object
+    a = transfers.analyze_memory({"transfers": 7})
+    assert any("not an object" in p for p in a["problems"])
+    # garbage per-node entries dropped by name, the rest reconcile
+    art = _artifact()
+    art["transfers"]["node_hbm"]["zz"] = ["garbage"]
+    art["transfers"]["static_hbm_by_node"]["yy"] = "much"
+    a = transfers.analyze_memory(art)
+    assert any("'zz'" in p for p in a["problems"])
+    assert any("'yy'" in p for p in a["problems"])
+    assert "divergence" in a["nodes"]["round1_polish"]
+    # garbage host_round_trip_bytes named, not crashed on
+    a = transfers.analyze_memory(_artifact(host_round_trip_bytes="lots"))
+    assert any("host_round_trip_bytes" in p for p in a["problems"])
+    # static only (CPU backend: no memory stats) -> named degradation
+    a = transfers.analyze_memory(_artifact(node_hbm={}))
+    assert any("no measured per-node HBM samples" in p for p in a["problems"])
+    # not even a dict
+    assert transfers.analyze_memory([])["problems"]
+
+
+def test_render_memory_smoke():
+    lines: list[str] = []
+    transfers.render_memory(transfers.analyze_memory(_artifact()), lines)
+    text = "\n".join(lines)
+    assert "static graftcheck estimate vs measured" in text
+    assert "round1_polish" in text
+    lines = []
+    transfers.render_memory(transfers.analyze_memory({}), lines)
+    assert any("memory problem:" in ln for ln in lines)
+
+
+# --- the --report --memory surface (same ladder as --critical-path) ----------
+
+
+def _write_artifact(tmp_path, payload) -> str:
+    wd = tmp_path / "nano_tcr"
+    wd.mkdir(exist_ok=True)
+    (wd / "telemetry.json").write_text(
+        payload if isinstance(payload, str) else json.dumps(payload))
+    return str(wd)
+
+
+def test_report_memory_text(tmp_path, capsys):
+    wd = _write_artifact(tmp_path, _artifact())
+    assert obs_report.report_main(wd, memory=True) == 0
+    out = capsys.readouterr().out
+    assert "-- memory reconciliation --" in out
+    assert "round1_polish" in out and "data plane:" in out
+
+
+def test_report_memory_json_machine_dump(tmp_path, capsys):
+    wd = _write_artifact(tmp_path, _artifact())
+    assert obs_report.report_main(wd, as_json=True, memory=True) == 0
+    data = json.loads(capsys.readouterr().out)
+    mem = data["memory"]["telemetry.json"]
+    assert mem["nodes"]["round1_polish"]["static_bytes"] == 4000
+    assert mem["problems"] == []
+
+
+def test_report_memory_json_never_crash_matches_text_exit_codes(tmp_path,
+                                                                capsys):
+    """Exit-code parity on the degradation ladder: garbage transfers
+    section -> 1 both modes; a pre-upgrade artifact without the section
+    -> 0 with a named memory problem; nonsense target -> 2."""
+    wd = _write_artifact(tmp_path, '{"transfers": 7, "duration_s": 1.0}')
+    assert obs_report.report_main(wd, memory=True) == 1
+    text = capsys.readouterr().out
+    assert "malformed telemetry artifact telemetry.json" in text
+    assert obs_report.report_main(wd, as_json=True, memory=True) == 1
+    data = json.loads(capsys.readouterr().out)
+    assert any("malformed telemetry artifact" in p for p in data["problems"])
+    # pre-upgrade artifact: degradation is informational, not a failure
+    pre = tmp_path / "pre"
+    pre.mkdir()
+    wd2 = _write_artifact(pre, {"telemetry": "on", "duration_s": 1.0})
+    assert obs_report.report_main(wd2, memory=True) == 0
+    assert "no transfers section" in capsys.readouterr().out
+    assert obs_report.report_main(wd2, as_json=True, memory=True) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert any("no transfers section" in p
+               for p in data["memory"]["telemetry.json"]["problems"])
+    assert obs_report.report_main(str(tmp_path / "nope"), memory=True,
+                                  as_json=True) == 2
+
+
+# ---------------------------------------------------------------------------
+# history ledger fields + the bytes gate
+
+
+def _tele_with_transfers() -> dict:
+    return {
+        "duration_s": 5.0, "stages": {}, "dispatch": {},
+        "compile": {"count": 1, "seconds": 0.5}, "gauges": {},
+        "transfers": {
+            "sites": {S_SITE: {"h2d_bytes": 1000, "h2d": 2,
+                               "d2h_bytes": 300, "d2h": 1}},
+            "edges": {}, "host_round_trip_bytes": 128,
+            "donation": {"read_store": {"verdict": "donated",
+                                        "node": "round1_polish"}},
+        },
+    }
+
+
+def test_build_entry_carries_transfer_fields():
+    e = history.build_entry("run", _tele_with_transfers(), fingerprint="f",
+                            backend="cpu", n_reads=100)
+    assert e["transfer_bytes"] == {"h2d": 1000, "d2h": 300}
+    assert e["host_round_trip_bytes"] == 128
+    assert e["donation"] == {"read_store": "donated"}
+    # pre-upgrade telemetry: the keys are simply absent
+    e = history.build_entry("run", {"duration_s": 1.0}, fingerprint="f",
+                            backend="cpu", n_reads=100)
+    assert "transfer_bytes" not in e and "host_round_trip_bytes" not in e
+
+
+def _bentry(rt=None, **over) -> dict:
+    e = {"fingerprint": "f", "backend": "cpu", "n_reads": 100,
+         "duration_s": 10.0}
+    if rt is not None:
+        e["host_round_trip_bytes"] = rt
+    e.update(over)
+    return e
+
+
+def test_bytes_gate_pass_fail_and_zero_baseline():
+    base = [_bentry(rt=1000) for _ in range(4)]
+    assert history.evaluate_bytes_gate(base, _bentry(rt=1050)).status == "pass"
+    res = history.evaluate_bytes_gate(base, _bentry(rt=5000))
+    assert res.status == "fail"
+    assert "5000 B" in res.reason and "allowed" in res.reason
+    # a 0-byte baseline is the ideal: ANY reintroduced round-trip fails,
+    # with the measured bytes in the verdict (zero is a usable value
+    # here, unlike the timing gate's metrics)
+    zero = [_bentry(rt=0) for _ in range(4)]
+    res = history.evaluate_bytes_gate(zero, _bentry(rt=4096))
+    assert res.status == "fail" and "4096 B" in res.reason
+
+
+def test_bytes_gate_tolerates_legacy_ledgers():
+    # all-legacy baseline: WARN (recorded, not gated), names the skips
+    legacy = [_bentry() for _ in range(4)]
+    res = history.evaluate_bytes_gate(legacy, _bentry(rt=4096))
+    assert res.status == "warn" and "legacy" in res.reason
+    # mixed ledger: legacy entries are skipped, upgraded ones still gate
+    mixed = legacy + [_bentry(rt=100) for _ in range(3)]
+    res = history.evaluate_bytes_gate(mixed, _bentry(rt=9000))
+    assert res.status == "fail" and "legacy skipped" in res.reason
+    # current entry itself pre-upgrade: WARN, never a crash
+    res = history.evaluate_bytes_gate(mixed, _bentry())
+    assert res.status == "warn"
+
+
+def test_perf_gate_cli_mixed_ledger_transfer_verdict(tmp_path):
+    """The CLI surface: a mixed legacy/upgraded ledger gates the byte
+    metric on the upgraded entries only, fails with measured-vs-allowed
+    bytes, and keeps --json one parseable object."""
+    ledger = tmp_path / "ledger.jsonl"
+    with open(ledger, "w") as fh:
+        for _ in range(3):
+            fh.write(json.dumps(_bentry()) + "\n")
+        for _ in range(3):
+            fh.write(json.dumps(_bentry(rt=100)) + "\n")
+        fh.write(json.dumps(_bentry(rt=50000)) + "\n")
+    proc = subprocess.run(
+        [sys.executable, str(PERF_GATE), str(ledger)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "transfer FAIL" in proc.stdout and "allowed" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, str(PERF_GATE), str(ledger), "--json"],
+        capture_output=True, text=True, timeout=120)
+    verdict = json.loads(proc.stdout)
+    assert verdict["status"] == "pass"  # timing unchanged
+    assert verdict["transfer"]["status"] == "fail"
+    assert verdict["transfer"]["current"] == 50000.0
+    # an all-legacy ledger stays a valid baseline: transfer WARNs, rc 0
+    thin = tmp_path / "legacy.jsonl"
+    with open(thin, "w") as fh:
+        for _ in range(4):
+            fh.write(json.dumps(_bentry()) + "\n")
+    proc = subprocess.run(
+        [sys.executable, str(PERF_GATE), str(thin)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    assert "transfer WARN" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# donation-audit e2e (slow: full tiny pipeline under the graph executor)
+
+
+@pytest.mark.slow
+def test_donation_audit_e2e_tiny_pipeline(tmp_path):
+    """A default telemetry run commits the transfers section end to end:
+    per-edge bytes, donation verdicts in the closed vocabulary, static
+    per-node HBM from graftcheck, and a ledger entry carrying the
+    transfer fields — then bench-style gating catches a seeded round-trip
+    regression against that run's own baseline."""
+    from ont_tcrconsensus_tpu.io import fastx, simulator
+    from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+    from ont_tcrconsensus_tpu.pipeline.run import run_with_config
+
+    lib = simulator.simulate_library(
+        seed=7, num_regions=2, molecules_per_region=(2, 2),
+        reads_per_molecule=(5, 6), sub_rate=0.006, ins_rate=0.003,
+        del_rate=0.003, region_len=(700, 800),
+    )
+    fastx.write_fasta(tmp_path / "reference.fa", lib.reference.items())
+    fq = tmp_path / "fastq_pass" / "barcode01"
+    fq.mkdir(parents=True)
+    fastx.write_fastq(fq / "barcode01.fastq.gz", lib.reads)
+    cfg = RunConfig.from_dict({
+        "reference_file": str(tmp_path / "reference.fa"),
+        "fastq_pass_dir": str(tmp_path / "fastq_pass"),
+        "minimal_length": 600, "min_reads_per_cluster": 4,
+        "read_batch_size": 64, "polish_method": "poa",
+        "delete_tmp_files": False, "telemetry": "on",
+    })
+    run_with_config(cfg)
+    nano = tmp_path / "fastq_pass" / "nano_tcr"
+    tele = json.loads((nano / "telemetry.json").read_text())
+    tr = tele["transfers"]
+    assert tr["sites"] and tr["edges"]
+    assert isinstance(tr["host_round_trip_bytes"], int)
+    assert tr["donation"]
+    assert set(d["verdict"] for d in tr["donation"].values()) <= {
+        "donated", "copied", "unknown"}
+    assert tr["static_hbm_by_node"]  # graftcheck liveness, recorded armed
+    entries, problems = history.read_entries(str(nano / "history.jsonl"))
+    assert problems == [] and entries
+    assert "transfer_bytes" in entries[-1]
+    assert "host_round_trip_bytes" in entries[-1]
+    # seeded host round-trip vs this run's own baseline: the bytes gate
+    # names the regression in measured-vs-allowed bytes
+    base = entries * 3
+    seeded = dict(entries[-1])
+    seeded["host_round_trip_bytes"] = (
+        entries[-1]["host_round_trip_bytes"] * 10 + 100_000)
+    res = history.evaluate_bytes_gate(base, seeded)
+    assert res.status == "fail" and "host round-trip" in res.reason

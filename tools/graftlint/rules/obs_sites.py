@@ -39,7 +39,7 @@ from tools.graftlint.core import FileCtx, Finding, Project
 RULES = {
     "obs-unknown-site": "telemetry site literal (counter_add/gauge_max/"
                         "observe/pool_add/span/instant/dispatch/timed_get/"
-                        "stage/ring_event/progress_node_*) not in "
+                        "stage/ring_event/progress_node_*/h2d/d2h) not in "
                         "obs.KNOWN_SITES (dead metric/span name)",
     "obs-unplanted-site": "obs.KNOWN_SITES entry not planted at any "
                           "telemetry call site in the scanned tree",
@@ -63,6 +63,10 @@ _PLANT_FUNCS = {
     "progress_node_skip",                   # /progress plane keys its
     # node map by graph node name (literal plants only; the executor's
     # node.name args are dynamic and out of scope, like f-string sites)
+    "h2d", "d2h",                           # obs.transfers — device
+    # data-plane ledger plants at device_put/device_get boundaries;
+    # timed_get feeds d2h with its own (already-checked) site, so only
+    # literal transfer.* plants surface here
 }
 
 _REGISTRY_NAME = "OBS_SITES"
