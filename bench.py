@@ -326,6 +326,15 @@ def parse_args(argv=None):
     ap.add_argument("--gate-threshold", type=float, default=0.15)
     ap.add_argument("--gate-mad-k", type=float, default=4.0)
     ap.add_argument("--gate-min-samples", type=int, default=3)
+    ap.add_argument(
+        "--rt-budget", type=float,
+        default=float(os.environ.get("BENCH_RT_BUDGET", "0")),
+        help="absolute host_round_trip_bytes budget for --gate (bytes; "
+        "default 0 or the BENCH_RT_BUDGET env var): the data plane is "
+        "device-resident, so ANY measured round-trip fails the gate even "
+        "on a thin ledger; pass a negative value to fall back to the "
+        "relative median+MAD gate over the ledger baseline",
+    )
     return ap.parse_args(argv)
 
 
@@ -517,10 +526,13 @@ def main(argv=None) -> int:
             rc = 1
         # data-plane gate: host_round_trip_bytes, lower-better — a
         # reintroduced device->host->device flow fails with measured vs
-        # allowed bytes even when the timing gate stays green
+        # allowed bytes even when the timing gate stays green. The hard
+        # default is an absolute near-zero budget (no ledger history
+        # needed); --rt-budget <0 reverts to the relative baseline gate.
         transfer = obs_history.evaluate_bytes_gate(
             baseline, entry, rel_threshold=args.gate_threshold,
             mad_k=args.gate_mad_k, min_samples=args.gate_min_samples,
+            abs_budget=args.rt_budget if args.rt_budget >= 0 else None,
         )
         print(f"bench: transfer gate {transfer.status.upper()} — "
               f"{transfer.reason}", file=sys.stderr)

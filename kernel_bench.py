@@ -63,6 +63,11 @@ MEASURED_PEAK_GCELLS = {
     "pileup": 65.941,
 }
 PEAK_PROVENANCE = "best on-chip capture 2026-08-02, TPU v5 lite (r5)"
+# The lane-packed pileup layout claims ~2x the pre-packing rate; the
+# committed KERNEL_BENCH.json must say whether the claim held on-chip, so
+# bench_pileup carries an explicit certification verdict against this
+# target instead of leaving the 65.9 Gcell/s capture to speak for itself.
+LANE_PACKED_TARGET_GCELLS = 100.0
 # MXU peak for the RNN serving matmuls (v5e bf16; fp32 serving runs lower,
 # so this mfu_est is a lower bound on achievable headroom).
 PEAK_MXU_FLOPS_V5E = 197e12
@@ -187,6 +192,8 @@ def bench_pileup(iters: int) -> dict:
         "peak_model": f"{MEASURED_PEAK_GCELLS['pileup']} Gcell/s, "
                       f"{PEAK_PROVENANCE} (pre-lane-packing layout; the "
                       "packed kernel targets ~2x of it)",
+        "lane_packed_target_gcells": LANE_PACKED_TARGET_GCELLS,
+        "lane_packed_certified": bool(gc >= LANE_PACKED_TARGET_GCELLS),
         "shapes": {"lanes": PILEUP_LANES, "len": PILEUP_LEN, "band": PILEUP_BAND},
         "compile_s": round(comp, 1),
         "iter_ms": round(dt * 1e3, 2),

@@ -212,8 +212,13 @@ def _round_trips(spec: GraphSpec, max_hops: int = 8) -> list[Finding]:
     findings: list[Finding] = []
 
     def host_outputs(name: str) -> list[str]:
+        # meta host edges (Edge.meta) carry orchestration metadata — stats,
+        # groupings, selections — not device-derived bulk payload, so they
+        # are not round-trip carriers; the transfer ledger still measures
+        # their bytes per edge, keeping the declaration falsifiable
         return [e for e in spec.nodes[name].outputs
-                if e in spec.edges and spec.edges[e].placement == "host"]
+                if e in spec.edges and spec.edges[e].placement == "host"
+                and not getattr(spec.edges[e], "meta", False)]
 
     def walk(path: tuple[str, ...], node: str) -> None:
         # path alternates node, edge, node, ... and starts at a device node
@@ -258,6 +263,20 @@ def round_trip_edges(spec: GraphSpec) -> set[str]:
     for f in _round_trips(spec):
         out.update(p for i, p in enumerate(f.path) if i % 2)
     return out
+
+
+def donation_plan(spec: GraphSpec) -> dict[str, frozenset[str]]:
+    """node name -> hbm input edges whose buffers it may consume in place.
+
+    The executor-facing face of the liveness donation proof: an hbm edge
+    whose last consumer is ``node`` (and which is not a graph result) is
+    dropped by the executor immediately after ``node`` runs, so no live
+    reference to its value can exist afterwards and the node's jitted
+    entry may take the buffer via ``donate_argnums``.  Byte estimates are
+    irrelevant to the proof, so no byte model is consulted.
+    """
+    donation = _liveness(spec, {})[3]
+    return {node: frozenset(edges) for node, edges in donation.items()}
 
 
 def _reshard_sites(spec: GraphSpec) -> list[Finding]:

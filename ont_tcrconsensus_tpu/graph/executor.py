@@ -108,6 +108,14 @@ class GraphExecutor:
         self._rt_edges = (graph_check.round_trip_edges(spec)
                           if obs_metrics.armed() else set())
 
+        # donation plan from the liveness proof: per node, the hbm input
+        # edges this node is the last consumer of.  Node bodies read
+        # ``ctx.donate_edges`` to decide which jitted entries may take
+        # ``donate_argnums`` — the static proof drives the runtime
+        # discipline, so adding a second consumer to an edge silently
+        # and safely withdraws its donation.
+        self._donation_plan = graph_check.donation_plan(spec)
+
         skip, resume_node = self._resume_scan()
         values = dict(inputs)
         refs: dict[str, int] = {}
@@ -141,12 +149,17 @@ class GraphExecutor:
             if node.checkpoint:
                 self._commit_pending(values, refs)
             audit = self._donation_probe(node, values, refs)
+            self._set_donate_edges(node)
             outputs = self._run_node(node, node_inputs, units)
             if audit:
                 out_probe = obs_transfers.buffer_probe(outputs)
                 for e, probe in audit.items():
+                    # re-probe the input AFTER the call: a buffer that
+                    # now reads deleted was taken by XLA (donated) even
+                    # when the output landed at a different address
+                    post = obs_transfers.buffer_probe(values.get(e))
                     obs_transfers.audit_donation(e, node.name, probe,
-                                                 out_probe)
+                                                 out_probe, post)
             self._absorb(node, outputs, values, refs)
         self._commit_pending(values, refs)
         return {e: values[e] for e in spec.results}
@@ -192,6 +205,17 @@ class GraphExecutor:
                 and spec.edges[e].placement == "hbm"
                 and e not in spec.results)
         }
+
+    def _set_donate_edges(self, node: Node) -> None:
+        """Publish the node's donation-eligible hbm input edges on the
+        context as ``ctx.donate_edges`` before its body runs.  Best
+        effort: a context that rejects attribute assignment (slots,
+        frozen test doubles) simply runs without donation."""
+        try:
+            self.ctx.donate_edges = self._donation_plan.get(
+                node.name, frozenset())
+        except Exception:
+            pass
 
     def _run_node(self, node: Node, inputs: dict, units: int) -> dict:
         ctx = self.ctx

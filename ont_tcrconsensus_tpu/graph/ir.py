@@ -48,11 +48,21 @@ class Edge:
     pairs producer-side and consumer-side specs and reports any node whose
     hbm inputs and outputs disagree as a reshard site.  Only ``hbm`` edges
     may carry one — host/disk values have no device layout.
+
+    ``meta`` marks a ``host`` edge as orchestration metadata: stats,
+    groupings, index selections — small coordination values whose bytes
+    are negligible next to the bulk stores and whose host residency is by
+    design, not an accident of the data plane.  graftcheck's round-trip
+    analysis skips meta edges (they are not re-uploaded payload), while
+    the transfer ledger still measures their bytes per edge, so the
+    declaration is auditable rather than a blind waiver.  Only ``host``
+    edges may carry it — an hbm/disk value cannot be "metadata at rest".
     """
 
     name: str
     placement: str
     sharding: str | None = None
+    meta: bool = False
 
 
 @dataclasses.dataclass
@@ -197,7 +207,7 @@ class GraphBuilder:
         self._problems: list[str] = []
 
     def edge(self, name: str, placement: str,
-             sharding: str | None = None) -> None:
+             sharding: str | None = None, meta: bool = False) -> None:
         if name in self._edges:
             self._problems.append(f"edge {name!r} declared twice")
             return
@@ -220,7 +230,13 @@ class GraphBuilder:
                     "layout)"
                 )
                 sharding = None
-        self._edges[name] = Edge(name, placement, sharding)
+        if meta and placement != "host":
+            self._problems.append(
+                f"edge {name!r}: meta declared on a {placement!r} edge "
+                "(only host-placed orchestration values can be metadata)"
+            )
+            meta = False
+        self._edges[name] = Edge(name, placement, sharding, meta)
 
     def input(self, name: str, placement: str = "disk") -> None:
         self.edge(name, placement)
@@ -375,11 +391,16 @@ def _check_resume_boundaries(spec: GraphSpec) -> list[str]:
             )
         for e in spec.crossing_edges(node.name):
             placement = spec.edges[e].placement if e in spec.edges else "?"
-            if placement == "hbm":
+            if placement == "hbm" and e not in node.resume_provides:
+                # an hbm crossing edge IS allowed when the reload rebuilds
+                # it (re-encode + re-upload from the disk artifact) — that
+                # is how the device-resident round1→round2 hand-off
+                # coexists with the round-1 checkpoint. Uncovered device
+                # memory still cannot survive a restart.
                 problems.append(
                     f"hbm edge {e!r} crosses the disk-resume boundary of "
-                    f"node {node.name!r} (device memory cannot survive a "
-                    "restart)"
+                    f"node {node.name!r} but its reload does not provide "
+                    "it (device memory cannot survive a restart)"
                 )
             elif e not in node.resume_provides:
                 problems.append(

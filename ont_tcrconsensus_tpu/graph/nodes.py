@@ -241,6 +241,10 @@ def round1_polish(ctx, inputs: dict) -> dict:
     n_clusters = sum(len(s) for _, s in selected_by_group)
     _log(f"Polishing clusters: {ctx.lay.library} "
          f"({n_clusters} clusters over {len(selected_by_group)} region clusters)")
+    # the executor publishes its liveness-proof donation plan as
+    # ctx.donate_edges: read_store dropping at this node is the proof
+    # that the polish dispatches may donate their per-round uploads
+    donate = "read_store" in getattr(ctx, "donate_edges", ())
     by_group, polish_failed = stages.polish_clusters_all(
         selected_by_group, inputs["read_store"],
         max_read_length=ctx.cfg.max_read_length,
@@ -248,6 +252,8 @@ def round1_polish(ctx, inputs: dict) -> dict:
         budget=ctx.budget,
         cluster_batch=ctx.cfg.cluster_batch_size,
         mesh=ctx.engine.mesh,
+        keep_codes=True,
+        donate=donate,
     )
     return {"r1_polished": (by_group, polish_failed)}
 
@@ -256,12 +262,17 @@ def round1_consensus(ctx, inputs: dict) -> dict:
     """Merged consensus assembly + the round-1 resume checkpoint: an
     incomplete round 1 is NOT checkpointed so resume retries the failed
     groups instead of reusing a consensus missing them."""
-    from ont_tcrconsensus_tpu.io import fastx
+    from ont_tcrconsensus_tpu.io import bucketing, fastx
+    from ont_tcrconsensus_tpu.ops import encode
     from ont_tcrconsensus_tpu.robustness import contracts, faults, shutdown
 
     lay = ctx.lay
     by_group, polish_failed = inputs["r1_polished"]
-    merged_consensus: list[tuple[str, str]] = []
+    # r1_polished carries (header, uint8 code vector) pairs — the
+    # device-resident hand-off; strings materialize ONLY at the fasta
+    # artifact boundary below (decode∘encode is bijective on codes 0..4,
+    # so the artifact is byte-identical to the string-path one)
+    merged: list[tuple[str, object]] = []
     for group_name, selected in inputs["selected_by_group"]:
         if group_name in polish_failed:
             ctx.failed_groups.append((group_name, polish_failed[group_name]))
@@ -275,7 +286,15 @@ def round1_consensus(ctx, inputs: dict) -> dict:
                 len(by_group[group_name]), "selected clusters", len(selected),
                 detail={"library": lay.library, "group": group_name},
             )
-            merged_consensus.extend(by_group[group_name])
+            merged.extend(by_group[group_name])
+    cons_codes = bucketing.EncodedRecords(
+        headers=[h for h, _ in merged],
+        codes=[c for _, c in merged],
+    )
+    merged_consensus = [
+        (h, encode.decode_seq(c, int(c.size)))
+        for h, c in zip(cons_codes.headers, cons_codes.codes)
+    ]
     if ctx.failed_groups:
         _log(
             "Not all umi cluster region fastas were successfully polished! "
@@ -298,7 +317,8 @@ def round1_consensus(ctx, inputs: dict) -> dict:
     # here resumes into round 2 only, byte-identically
     faults.inject("run.round1_checkpoint")
     shutdown.checkpoint("run.round1_checkpoint")
-    return {"merged_consensus": merged_consensus, "merged_fasta": merged_path}
+    return {"merged_consensus": merged_consensus, "merged_fasta": merged_path,
+            "cons_codes": cons_codes}
 
 
 def round1_resume_probe(ctx):
@@ -307,35 +327,47 @@ def round1_resume_probe(ctx):
 
 
 def round1_resume_reload(ctx) -> dict:
-    from ont_tcrconsensus_tpu.io import fastx
+    from ont_tcrconsensus_tpu.io import bucketing, fastx
+    from ont_tcrconsensus_tpu.ops import encode
 
     merged_path = os.path.join(ctx.lay.fasta, "merged_consensus.fasta")
     _log("Resuming from round-1 consensus:", ctx.lay.library)
     merged_consensus = [
         (rec.header, rec.sequence) for rec in fastx.read_fastx(merged_path)
     ]
-    return {"merged_consensus": merged_consensus}
+    # re-encode the checkpointed fasta into the hbm hand-off the resume
+    # boundary promises (resume_provides): encode∘decode round-trips the
+    # 0..4 alphabet exactly, so a resumed round 2 sees byte-identical
+    # batches to the un-resumed run
+    cons_codes = bucketing.EncodedRecords(
+        headers=[h for h, _ in merged_consensus],
+        codes=[encode.encode_seq(s) for _, s in merged_consensus],
+    )
+    return {"merged_consensus": merged_consensus, "cons_codes": cons_codes}
 
 
 # -- round 2 ---------------------------------------------------------------
 
 
 def round2_fused_assign(ctx, inputs: dict) -> dict:
-    from ont_tcrconsensus_tpu.io import fastx
     from ont_tcrconsensus_tpu.pipeline import run as run_mod
     from ont_tcrconsensus_tpu.pipeline import stages
     from ont_tcrconsensus_tpu.qc import artifacts
     from ont_tcrconsensus_tpu.robustness import retry
 
     cfg, lay = ctx.cfg, ctx.lay
-    merged_consensus = inputs["merged_consensus"]
+    # consume the device-resident hand-off: round 1's polished codes
+    # arrive pre-encoded (EncodedRecords), so batching skips the
+    # decode→re-encode round trip entirely — encode/decode are bijective
+    # over the 0..4 alphabet, so the batches are byte-identical to the
+    # string path
+    cons_codes = inputs["cons_codes"]
     _log("Aligning unique molecule consensus TCR sequences:", lay.library)
-    cons_records = [fastx.FastxRecord(h, "", s) for h, s in merged_consensus]
     qc_rows: list[dict] = []
     dispatch = None
     if cfg.round2_targeted_assign:
         dispatch, why_not = run_mod._targeted_round2_dispatch(
-            ctx.panel, ctx.engine_notrim, (h for h, _ in merged_consensus)
+            ctx.panel, ctx.engine_notrim, iter(cons_codes.headers)
         )
         if dispatch is None:
             _log(f"round 2: targeted assign unavailable ({why_not}); "
@@ -343,7 +375,7 @@ def round2_fused_assign(ctx, inputs: dict) -> dict:
     cons_store, cstats = retry.call_with_retry(
         "assign.round2",
         lambda: stages.run_assign(
-            cons_records, ctx.engine_notrim,
+            cons_codes, ctx.engine_notrim,
             max_ee_rate=1.0,  # no quality data on consensus sequences
             min_len=1,
             minimal_region_overlap=ctx.overlap_consensus,

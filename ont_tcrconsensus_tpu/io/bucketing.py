@@ -285,6 +285,69 @@ def batch_parsed_chunks(
             yield flush(int(w))
 
 
+@dataclasses.dataclass
+class EncodedRecords:
+    """Pre-encoded reads: parallel header/code-vector lists.
+
+    The device-resident hand-off type: a producer that already holds
+    uint8 code vectors (round-1 consensus output under ``keep_codes``)
+    passes them straight to :func:`batch_encoded` instead of decoding to
+    strings and re-encoding through the parser path. Code vectors are
+    1-d uint8 in 0..4; decode∘encode bijectivity on that alphabet makes
+    the resulting batches byte-identical to string-path batches of the
+    same sequences.
+    """
+
+    headers: list[str]
+    codes: list[np.ndarray]
+
+    def __len__(self) -> int:
+        return len(self.headers)
+
+
+def batch_encoded(
+    records: EncodedRecords,
+    batch_size: int = 2048,
+    widths: Sequence[int] = DEFAULT_WIDTHS,
+    min_len: int = 1,
+    counters: IngestCounters | None = None,
+) -> Iterator[ReadBatch]:
+    """:func:`batch_reads` over :class:`EncodedRecords` — no string pass.
+
+    Same bucketing, same drop gates and counter accounting, same flush
+    policy; batches materialize through :func:`_rows_to_batch` (the
+    single padded-batch policy owner), with no qualities — consensus
+    sequences carry none, exactly like the FASTA record path.
+    """
+    pending: dict[int, list] = {w: [] for w in widths}
+
+    def flush(w: int) -> ReadBatch:
+        rows = pending[w]
+        pending[w] = []
+        return _rows_to_batch(rows, w, batch_size, has_quals=False)
+
+    for header, codes in zip(records.headers, records.codes):
+        codes = np.asarray(codes, dtype=np.uint8)
+        ln = int(codes.size)
+        if counters is not None:
+            counters.n_records += 1
+        if ln < min_len:
+            if counters is not None:
+                counters.n_dropped_short += 1
+            continue
+        w = bucket_width(ln, widths)
+        if w is None:
+            if counters is not None:
+                counters.n_dropped_long += 1
+            continue
+        pending[w].append((codes, None, header))
+        if len(pending[w]) == batch_size:
+            yield flush(w)
+    for w in widths:
+        if pending[w]:
+            yield flush(w)
+
+
 def _make_batch(recs: list, width: int, batch_size: int, with_quals: bool) -> ReadBatch:
     n = len(recs)
     # partial batches pad to the pow2 of the real count (see batch_parsed_reads)

@@ -255,31 +255,53 @@ import functools as _functools  # noqa: E402
 
 
 @_functools.lru_cache(maxsize=None)
-def _sharded_polish_from_pileup(mesh, bf16=False):
-    """Cluster-axis-sharded RNN serving (params replicated; no collectives)."""
+def _sharded_polish_from_pileup(mesh, bf16=False, donate=False):
+    """Cluster-axis-sharded RNN serving (params replicated; no collectives).
+
+    ``donate`` hands the drafts upload (arg 4) to XLA: ``pred`` shares
+    its (C, W) uint8 shape, so the serving output reuses the input
+    buffer's HBM in place. Callers donate only fresh per-call uploads.
+    """
     from ont_tcrconsensus_tpu.parallel.mesh import shard_map_compat as shard_map
     from jax.sharding import PartitionSpec as P
 
     d = P("data")
+    kw = {"donate_argnums": (4,)} if donate else {}
     return jax.jit(shard_map(
         _functools.partial(_polish_from_pileup, bf16=bf16), mesh=mesh,
         in_specs=(P(), d, d, d, d), out_specs=(d,) * 5,
         check_vma=False,
-    ))
+    ), **kw)
 
 
 @_functools.lru_cache(maxsize=None)
-def _sharded_polish_from_pileup_v4(mesh, bf16=False):
-    """v4 twin of :func:`_sharded_polish_from_pileup`."""
+def _sharded_polish_from_pileup_v4(mesh, bf16=False, donate=False):
+    """v4 twin of :func:`_sharded_polish_from_pileup` (drafts is arg 5)."""
     from ont_tcrconsensus_tpu.parallel.mesh import shard_map_compat as shard_map
     from jax.sharding import PartitionSpec as P
 
     d = P("data")
+    kw = {"donate_argnums": (5,)} if donate else {}
     return jax.jit(shard_map(
         _functools.partial(_polish_from_pileup_v4, bf16=bf16), mesh=mesh,
         in_specs=(P(), d, d, d, d, d, d, d), out_specs=(d,) * 5,
         check_vma=False,
-    ))
+    ), **kw)
+
+
+@_functools.lru_cache(maxsize=None)
+def _donating_polish_from_pileup(bf16=False):
+    """Unsharded serving with the drafts upload donated (arg 4 aliases
+    the uint8 prediction plane)."""
+    return jax.jit(_functools.partial(_polish_from_pileup, bf16=bf16),
+                   donate_argnums=(4,))
+
+
+@_functools.lru_cache(maxsize=None)
+def _donating_polish_from_pileup_v4(bf16=False):
+    """v4 twin of :func:`_donating_polish_from_pileup` (drafts is arg 5)."""
+    return jax.jit(_functools.partial(_polish_from_pileup_v4, bf16=bf16),
+                   donate_argnums=(5,))
 
 
 def make_pipeline_polisher(params, band_width: int | None = None,
@@ -344,38 +366,47 @@ def make_pipeline_polisher(params, band_width: int | None = None,
     need_v4 = wants_v4 or low_v4
 
     def polish(sub, lens, drafts, dlens, pileup=None, band_width=None,
-               mesh=None, quals=None, strands=None):
+               mesh=None, quals=None, strands=None, donate=False):
         for _ in range(max(int(iterations), 1)):
             drafts, dlens = _polish_once(
                 sub, lens, drafts, dlens, pileup=pileup,
                 band_width=band_width, mesh=mesh,
-                quals=quals, strands=strands,
+                quals=quals, strands=strands, donate=donate,
             )
             pileup = None  # later passes re-pile vs the new draft
         return drafts, dlens
 
     def _serve_from_pileup(p, v4, base_at, ins_cnt, ins_base, pos_at,
-                           drafts_d, quals, strands, mesh):
+                           drafts_d, quals, strands, mesh, donate=False):
         if v4:
             if mesh is None:
+                if donate:
+                    return _donating_polish_from_pileup_v4(bf16)(
+                        p, base_at, ins_cnt, ins_base, pos_at, drafts_d,
+                        jnp.asarray(quals), jnp.asarray(strands),
+                    )
                 return _polish_from_pileup_v4_jit(
                     p, base_at, ins_cnt, ins_base, pos_at, drafts_d,
                     jnp.asarray(quals), jnp.asarray(strands), bf16=bf16,
                 )
-            return _sharded_polish_from_pileup_v4(mesh, bf16)(
+            return _sharded_polish_from_pileup_v4(mesh, bf16, donate)(
                 p, base_at, ins_cnt, ins_base, pos_at, drafts_d,
                 jnp.asarray(quals), jnp.asarray(strands),
             )
         if mesh is None:
+            if donate:
+                return _donating_polish_from_pileup(bf16)(
+                    p, base_at, ins_cnt, ins_base, drafts_d
+                )
             return _polish_from_pileup_jit(
                 p, base_at, ins_cnt, ins_base, drafts_d, bf16=bf16
             )
-        return _sharded_polish_from_pileup(mesh, bf16)(
+        return _sharded_polish_from_pileup(mesh, bf16, donate)(
             p, base_at, ins_cnt, ins_base, drafts_d
         )
 
     def _polish_once(sub, lens, drafts, dlens, pileup=None, band_width=None,
-                     mesh=None, quals=None, strands=None):
+                     mesh=None, quals=None, strands=None, donate=False):
         """``band_width`` is forwarded by the polish stage so recomputed
         pileups use the SAME band the consensus rounds (and any reused
         pileup) did — two knobs drifting apart would mix feature scales
@@ -384,7 +415,20 @@ def make_pipeline_polisher(params, band_width: int | None = None,
         ``quals`` (C,S,W) phred / ``strands`` (C,S) bool-is-rev feed the
         v4 feature channels; with v4 weights but no quals (FASTA input)
         the QUAL_FILL constant stands in — the same fill a fraction of
-        training examples used, so it stays in-distribution."""
+        training examples used, so it stays in-distribution.
+        ``donate`` (the graph-executor donation discipline) donates each
+        serving dispatch's fresh drafts upload into its prediction
+        output — every serve below does its own ``jnp.asarray(drafts)``
+        from the numpy master, so main and low-depth serves each own the
+        buffer they donate. Ignored on CPU (XLA:CPU doesn't honor
+        donation and would warn per compile)."""
+        donate = donate and jax.default_backend() != "cpu"
+        if donate:
+            # the donation safety argument requires a HOST master: each
+            # serve's jnp.asarray(drafts) must be a fresh upload owning
+            # its buffer. A device-resident drafts would alias one buffer
+            # across both serves (and the np.asarray readback below).
+            drafts = np.asarray(drafts)
         if mesh is not None and np.asarray(drafts).shape[0] % mesh_data_size(mesh):
             mesh = None
         live = (np.asarray(lens) > 0).sum(axis=1)
@@ -418,12 +462,13 @@ def make_pipeline_polisher(params, band_width: int | None = None,
             base_at, ins_cnt, ins_base, pos_at = pileup
             out = _serve_from_pileup(
                 params, wants_v4, base_at, ins_cnt, ins_base, pos_at,
-                jnp.asarray(drafts), quals, strands, mesh,
+                jnp.asarray(drafts), quals, strands, mesh, donate,
             )
             if use_low:
                 out_low = _serve_from_pileup(
                     low_depth_params, low_v4, base_at, ins_cnt, ins_base,
                     pos_at, jnp.asarray(drafts), quals, strands, mesh,
+                    donate,
                 )
         elif mesh is not None:
             out = _device_polish_batch(

@@ -390,7 +390,8 @@ def _bytes_of(entry: dict, metric: str) -> float | None:
 def evaluate_bytes_gate(entries: list[dict], current: dict, *,
                         metric: str = "host_round_trip_bytes",
                         rel_threshold: float = 0.15, mad_k: float = 4.0,
-                        min_samples: int = 3) -> GateResult:
+                        min_samples: int = 3,
+                        abs_budget: float | None = None) -> GateResult:
     """Lower-is-better byte gate over a ledger byte metric (the data-plane
     twin of :func:`evaluate_gate`; same median+MAD allowance).
 
@@ -400,12 +401,32 @@ def evaluate_bytes_gate(entries: list[dict], current: dict, *,
     timing gate without blocking CI on the new metric. The fail reason
     carries measured vs allowed bytes, so a reintroduced host round-trip
     is a sized finding.
+
+    ``abs_budget`` switches the gate to an absolute ceiling that needs no
+    ledger history at all: the production data plane is device-resident,
+    so the budget is ~0 bytes and ANY measured round-trip fails
+    deterministically — including on a fresh machine whose ledger is too
+    thin for the relative gate to arm.
     """
     cur = _bytes_of(current, metric)
     if cur is None:
         return GateResult(
             "warn", f"current entry has no {metric} field (pre-upgrade "
             "telemetry or telemetry off) — not gated", metric=metric,
+        )
+    if abs_budget is not None:
+        if cur > abs_budget:
+            return GateResult(
+                "fail",
+                f"data-plane regression: {metric}={cur:.0f} B vs allowed "
+                f"{abs_budget:.0f} B (absolute budget) — "
+                f"{cur - abs_budget:.0f} B of new host round-trip traffic",
+                metric=metric, current=cur, allowance=float(abs_budget),
+            )
+        return GateResult(
+            "pass", f"within absolute budget: {metric}={cur:.0f} B vs "
+            f"allowed {abs_budget:.0f} B", metric=metric, current=cur,
+            allowance=float(abs_budget),
         )
     pool = matching_entries(entries, current)
     values = [v for e in pool

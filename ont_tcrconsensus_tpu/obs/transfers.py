@@ -155,23 +155,42 @@ def _leaves(value: Any, _depth: int = 0) -> Iterator[Any]:
         yield value
 
 
-def buffer_probe(value: Any) -> tuple[set[int], bool] | None:
+def buffer_probe(value: Any) -> tuple[set[int], bool, int] | None:
     """Probe buffer identity of every jax array inside ``value``.
 
-    Returns ``(pointer set, saw_non_cpu_device)`` or None when no leaf
-    exposes a readable ``unsafe_buffer_pointer`` (non-jax values,
-    sharded arrays that refuse the call, deleted buffers) — the caller
-    degrades that to a ``unknown`` verdict rather than guessing.
+    Returns ``(pointer set, saw_non_cpu_device, n_deleted)`` or None
+    when no leaf exposes ``unsafe_buffer_pointer`` at all (non-jax
+    values) — the caller degrades that to a ``unknown`` verdict rather
+    than guessing.  A leaf whose probe raises the runtime's
+    deleted/donated-buffer error is NOT a probe failure: after a
+    donating call it is positive evidence that XLA took the buffer, so
+    those leaves are counted in ``n_deleted`` (and a probe that finds
+    only deleted leaves still returns, with an empty pointer set) while
+    genuinely unreadable leaves (sharded arrays refusing the call) are
+    skipped as before.
     """
     ptrs: set[int] = set()
     non_cpu = False
+    deleted = 0
+    probed = False
     for leaf in _leaves(value):
         fn = getattr(leaf, "unsafe_buffer_pointer", None)
         if fn is None:
             continue
+        probed = True
         try:
             ptrs.add(int(fn()))
-        except Exception:  # sharded/donated/deleted buffer: skip the leaf
+        except RuntimeError as exc:
+            # jax raises RuntimeError("Array has been deleted...") /
+            # ("...buffer ... deleted") once donation or an explicit
+            # .delete() invalidates the buffer — that is testimony of
+            # donation, not an opaque failure
+            if "delet" in str(exc).lower() or _leaf_is_deleted(leaf):
+                deleted += 1
+            continue
+        except Exception:  # sharded buffer refusing the call: skip the leaf
+            if _leaf_is_deleted(leaf):
+                deleted += 1
             continue
         try:
             if any(getattr(d, "platform", "cpu") != "cpu"
@@ -179,41 +198,75 @@ def buffer_probe(value: Any) -> tuple[set[int], bool] | None:
                 non_cpu = True
         except Exception:  # device introspection is advisory only
             pass
-    return (ptrs, non_cpu) if ptrs else None
+    if not probed:
+        return None
+    return (ptrs, non_cpu, deleted) if (ptrs or deleted) else None
 
 
-def donation_verdict(in_probe: tuple[set[int], bool] | None,
-                     out_probe: tuple[set[int], bool] | None) -> str:
+def _leaf_is_deleted(leaf: Any) -> bool:
+    """Ask the array itself whether its buffer is gone (jax exposes
+    ``is_deleted()``); False on any doubt — deletion evidence must be
+    positive, never inferred from a probe that merely errored."""
+    try:
+        is_deleted = getattr(leaf, "is_deleted", None)
+        return bool(is_deleted()) if callable(is_deleted) else False
+    except Exception:
+        return False
+
+
+def _deleted_count(probe: tuple | None) -> int:
+    """Deleted-leaf count from a probe tuple; legacy 2-tuples carry 0."""
+    if probe is None or len(probe) < 3:
+        return 0
+    n = probe[2]
+    return int(n) if isinstance(n, int) and not isinstance(n, bool) else 0
+
+
+def donation_verdict(in_probe: tuple | None,
+                     out_probe: tuple | None,
+                     post_probe: tuple | None = None) -> str:
     """Pure verdict logic: did a donation-eligible input buffer get
     reused by the node's outputs?
+
+    Probe tuples are ``(pointer set, saw_non_cpu_device[, n_deleted])``;
+    the two-element legacy shape is accepted (deleted count 0).
+    ``post_probe`` is an optional re-probe of the *input* value after
+    the call returned.
 
     - no readable input pointers -> ``unknown`` (can't testify);
     - CPU-only buffers -> ``unknown`` (XLA:CPU aliasing is not the
       donation ROADMAP-1 certifies; a CPU run must not report a fake
       ``copied`` regression);
     - input pointer reappears among outputs -> ``donated``;
-    - readable on-device input, disjoint outputs -> ``copied`` (the
-      named finding: the buffer lived on after its drop point).
+    - input buffer reads *deleted* after the call (post_probe) ->
+      ``donated`` — XLA took the buffer even if the output landed at a
+      different address (reshaped/fused outputs);
+    - readable on-device input, disjoint live outputs -> ``copied``
+      (the named finding: the buffer lived on after its drop point).
     """
     if in_probe is None:
         return "unknown"
-    in_ptrs, non_cpu = in_probe
+    in_ptrs, non_cpu = in_probe[0], in_probe[1]
     if not non_cpu:
         return "unknown"
     if out_probe is not None and in_ptrs & out_probe[0]:
+        return "donated"
+    if _deleted_count(post_probe) > 0:
         return "donated"
     return "copied"
 
 
 def audit_donation(edge: str, node: str,
-                   in_probe: tuple[set[int], bool] | None,
-                   out_probe: tuple[set[int], bool] | None) -> None:
+                   in_probe: tuple | None,
+                   out_probe: tuple | None,
+                   post_probe: tuple | None = None) -> None:
     """Record the donation verdict for ``edge`` dropped at ``node``;
     free no-op when telemetry is off."""
     reg = metrics._ARMED
     if reg is not None:
         reg.counter_add("donation.audit")
-        reg.donation_set(edge, donation_verdict(in_probe, out_probe), node)
+        reg.donation_set(
+            edge, donation_verdict(in_probe, out_probe, post_probe), node)
 
 
 # --- measured per-node HBM --------------------------------------------------

@@ -207,7 +207,7 @@ def _sharded_vote_fn(mesh):
 
 @functools.lru_cache(maxsize=None)
 def _fused_round_fn(band_width: int, out_len: int, S: int, mesh,
-                    with_pos: bool = True):
+                    with_pos: bool = True, donate: bool = False):
     """ONE device dispatch per consensus round: banded forward + scan-log
     traceback + column vote fused into a single jitted program.
 
@@ -252,8 +252,13 @@ def _fused_round_fn(band_width: int, out_len: int, S: int, mesh,
             out = out + (pos_at.reshape(C, S, out_len),)
         return out
 
+    # drafts/dlens are fresh per-call uploads whose numpy sources the
+    # caller retains, so donating them (the graph-derived discipline; the
+    # output drafts reuse the input buffer's HBM) has no use-after-donate
+    # hazard even across a transient retry
+    jit_kwargs = {"donate_argnums": (2, 3)} if donate else {}
     if mesh is None:
-        return jax.jit(round_impl)
+        return jax.jit(round_impl, **jit_kwargs)
     from ont_tcrconsensus_tpu.parallel.mesh import shard_map_compat as shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -265,7 +270,7 @@ def _fused_round_fn(band_width: int, out_len: int, S: int, mesh,
         in_specs=(d2, d, d2, d),
         out_specs=(d2, d) + (d3,) * (n_out - 2),
         check_vma=False,
-    ))
+    ), **jit_kwargs)
 
 
 def _extend_ends_device(drafts, dlens, subreads, subread_lens, spans,
@@ -320,7 +325,7 @@ def _extend_ends_device(drafts, dlens, subreads, subread_lens, spans,
 
 @functools.lru_cache(maxsize=None)
 def _fused_pair_fn(band_width: int, out_len: int, S: int, mesh,
-                   with_pos: bool = True):
+                   with_pos: bool = True, donate: bool = False):
     """TWO consensus rounds per device dispatch: (forward + traceback +
     vote + end-extension) x 2, fused into one jitted program.
 
@@ -401,8 +406,12 @@ def _fused_pair_fn(band_width: int, out_len: int, S: int, mesh,
             out = out + (pa,)
         return out
 
+    # same donation contract as _fused_round_fn: drafts/dlens are fresh
+    # uploads (numpy masters stay host-side), d2/l2 match their
+    # shape/dtype exactly, so XLA aliases input->output in place
+    jit_kwargs = {"donate_argnums": (2, 3)} if donate else {}
     if mesh is None:
-        return jax.jit(pair_impl)
+        return jax.jit(pair_impl, **jit_kwargs)
     from ont_tcrconsensus_tpu.parallel.mesh import shard_map_compat as shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -414,7 +423,7 @@ def _fused_pair_fn(band_width: int, out_len: int, S: int, mesh,
         in_specs=(d2, d, d2, d),
         out_specs=(d2, d, d, d, d) + (d3,) * n_planes,
         check_vma=False,
-    ))
+    ), **jit_kwargs)
 
 
 def _extend_ends_batch(drafts, dlens, subreads, subread_lens, spans,
@@ -474,6 +483,7 @@ def consensus_clusters_batch(
     keep_pos: bool = True,
     mesh=None,
     force_fused: bool = False,
+    donate: bool = False,
 ) -> tuple[np.ndarray, np.ndarray] | tuple[np.ndarray, np.ndarray, tuple | None]:
     """Batched :func:`consensus_cluster` over C same-shape clusters.
 
@@ -495,6 +505,14 @@ def consensus_clusters_batch(
       force_fused: run the fused-dispatch path even on plain CPU — the
         parity-test hook for the fused pair program (like force_pallas on
         the pileup side).
+      donate: hand the per-round drafts/dlens uploads to XLA via
+        ``donate_argnums`` so each round's output drafts reuse the input
+        buffer's HBM instead of allocating a second copy (the
+        graph-executor donation discipline). Safe because those are
+        fresh per-call uploads whose numpy masters stay host-side; the
+        cached full-shape read upload (``d_sub_full``) is deliberately
+        NEVER donated — it is reused across rounds. Ignored on the CPU
+        backend, where XLA does not honor donation and would warn.
 
     Returns (drafts (C, W), draft_lens (C,)[, final_pileup]). On the fused
     path, rounds run in PAIRS of one device dispatch each
@@ -554,12 +572,15 @@ def consensus_clusters_batch(
     pile_parts: list[tuple[np.ndarray, tuple]] = []
     d_sub_full = d_lens_full = None
     with_pos = keep_final_pileup and keep_pos
+    donate = donate and jax.default_backend() != "cpu"
     pair_fn = round_fn = None
     if use_fused:
         if rounds >= 2:
-            pair_fn = _fused_pair_fn(band_width, W, S, mesh, with_pos)
+            pair_fn = _fused_pair_fn(band_width, W, S, mesh, with_pos,
+                                     donate)
         if rounds % 2:  # odd trailing round keeps the single-round program
-            round_fn = _fused_round_fn(band_width, W, S, mesh, with_pos)
+            round_fn = _fused_round_fn(band_width, W, S, mesh, with_pos,
+                                       donate)
 
     rounds_left = rounds
     while rounds_left > 0:

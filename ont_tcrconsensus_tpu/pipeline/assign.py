@@ -883,6 +883,17 @@ def _batches_from_source(source, batch_size, widths, subsample,
     parsing resynchronizes at the next record. Without a guard the legacy
     fail-fast behavior is unchanged.
     """
+    if isinstance(source, bucketing.EncodedRecords):
+        # device-resident hand-off: round-1 consensus codes feed round 2
+        # without a decode->string->re-encode detour (bijective on the
+        # 0..4 alphabet, so batches are byte-identical to the string
+        # path; pinned by the graph-vs-imperative identity test).
+        # subsample never applies here — consensus records are not raw
+        # reads and the imperative path never subsamples them either.
+        return bucketing.batch_encoded(
+            source, batch_size=batch_size, widths=widths, min_len=1,
+            counters=counters,
+        )
     if isinstance(source, (str, os.PathLike)):
         from ont_tcrconsensus_tpu.io import native
 

@@ -41,6 +41,34 @@ KERNEL_OUT = os.path.join(REPO, "KERNEL_BENCH.json")
 BENCH_OUT = os.path.join(REPO, "BENCH_TPU_CAPTURE.json")
 BENCH_FULL_OUT = os.path.join(REPO, "BENCH_TPU_CAPTURE_FULL.json")
 TPU_LANE_LOG = os.path.join(REPO, "TPU_LANE_PASS.log")
+BF16_AB_OUT = os.path.join(
+    REPO, "models", "weights", "polisher_bf16_ab_tpu.json")
+
+
+def bf16_ab_done() -> bool:
+    """A committed on-chip bf16 A/B artifact (scripts/bf16_ab.py): the
+    per-backend record the serving path consults before enabling bf16."""
+    try:
+        with open(BF16_AB_OUT) as fh:
+            rec = json.load(fh)
+        return rec.get("backend") == "tpu" and "identical" in rec
+    except (OSError, json.JSONDecodeError):
+        return False
+
+
+def pileup_cert_done() -> bool:
+    """The lane-packed pileup kernel's certification verdict is committed:
+    KERNEL_BENCH.json carries lane_packed_certified (either verdict — the
+    committed answer is the deliverable, kernel_bench states the target)."""
+    try:
+        with open(KERNEL_OUT) as fh:
+            rep = json.load(fh)
+        k = rep.get("kernels", {}).get("pileup", {})
+        return (rep.get("platform") == "tpu"
+                and k.get("value") is not None
+                and isinstance(k.get("lane_packed_certified"), bool))
+    except (OSError, json.JSONDecodeError):
+        return False
 
 
 def tpu_lane_done() -> bool:
@@ -173,6 +201,27 @@ def main() -> None:
             "label": "kernel_bench", "attempts": 0,
             "done": kernel_done,
             "cmd": [sys.executable, "kernel_bench.py", "--out", KERNEL_OUT],
+            "timeout": 1800, "out": None, "env": None,
+        },
+        {
+            # the lane-packed pileup certification verdict
+            # (lane_packed_certified vs the 100 Gcell/s target) is absent
+            # from pre-upgrade KERNEL_BENCH.json captures: re-run just the
+            # pileup kernel to commit it without discarding older results
+            "label": "kernel_bench pileup cert", "attempts": 0,
+            "done": pileup_cert_done,
+            "cmd": [sys.executable, "kernel_bench.py", "--kernel", "pileup",
+                    "--out", KERNEL_OUT],
+            "timeout": 900, "out": None, "env": None,
+        },
+        {
+            # bf16 RNN serving settle: the per-backend exactness A/B
+            # artifact models/polisher.py consults before enabling the
+            # bf16 fast path. EITHER verdict is the deliverable (diverged
+            # -> serving stays fp32, and the loop stops retrying).
+            "label": "bf16_ab", "attempts": 0,
+            "done": bf16_ab_done,
+            "cmd": [sys.executable, "scripts/bf16_ab.py"],
             "timeout": 1800, "out": None, "env": None,
         },
         {

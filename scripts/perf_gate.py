@@ -69,6 +69,12 @@ def main(argv: list[str] | None = None) -> int:
                     "fewer -> WARN, exit 0 (default 3)")
     ap.add_argument("--json", action="store_true",
                     help="emit the verdict as one JSON line")
+    ap.add_argument("--rt-budget", type=float, default=None, metavar="BYTES",
+                    help="absolute host_round_trip_bytes ceiling for the "
+                    "transfer verdict (the production data plane is "
+                    "device-resident, so ~0 is the honest budget); gates "
+                    "deterministically with no ledger history — omit to "
+                    "use the relative median+MAD baseline gate")
     args = ap.parse_args(argv)
 
     entries, problems = history.read_entries(args.ledger)
@@ -100,6 +106,7 @@ def main(argv: list[str] | None = None) -> int:
     transfer = history.evaluate_bytes_gate(
         entries, current, rel_threshold=args.threshold,
         mad_k=args.mad_k, min_samples=args.min_samples,
+        abs_budget=args.rt_budget,
     )
     if args.json:
         # one JSON object on stdout (consumers json.loads the whole

@@ -155,10 +155,13 @@ fi
 
 echo "--- perf-gate smoke (two tiny runs feed a shared history ledger:"
 echo "    scripts/perf_gate.py passes on an identical replay and fails on"
-echo "    a seeded +30% regression; --report --critical-path explains the"
-echo "    executed graph) ---"
-timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/test_history.py -q \
-    -k "perf_gate_passes_replay or perf_gate_cli or critical_path_matches" \
+echo "    a seeded +30% regression; a seeded host round-trip fails the"
+echo "    bytes gate under the near-zero --rt-budget with measured-vs-"
+echo "    allowed bytes; --report --critical-path explains the executed"
+echo "    graph) ---"
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_history.py tests/test_transfers.py -q \
+    -k "perf_gate_passes_replay or perf_gate_cli or critical_path_matches or rt_budget" \
     -p no:cacheprovider -p no:xdist -p no:randomly
 prc=$?
 if [ "$prc" -ne 0 ]; then

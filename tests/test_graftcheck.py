@@ -241,13 +241,13 @@ def test_production_graph_matches_committed_expected_list():
         "--write-expect tools/graftcheck/expected_production.json` and "
         "review the diff"
     )
-    # the committed list is the ROADMAP-1 worklist: every entry is a
-    # device->host round-trip advisory, none a violation
-    assert report.violations == []
-    assert all(f.kind == K_TRIP for f in report.advisories)
-    # the round1->round2 hand-off (polish -> consensus -> round2 assign)
-    # must be named until the hand-off goes device-resident
-    assert any("round2_fused_assign" in f.path for f in report.advisories)
+    # the ROADMAP-1 worklist is CLOSED: the data plane is device-resident
+    # (meta-declared orchestration edges + the encoded round1->round2
+    # hand-off), the committed expected list is empty, and ANY
+    # reintroduced host round-trip is a new finding that fails --expect
+    assert want == set() and report.findings == []
+    assert report.verdict == "clean"
+    assert not any("round2_fused_assign" in f.path for f in report.advisories)
 
 
 def test_production_liveness_reports_high_water():
@@ -296,31 +296,55 @@ def test_cli_human_and_json_agree(capsys):
     assert graftcheck_main(["--json"]) == 0
     body = json.loads(capsys.readouterr().out)
     assert body["exit_code"] == 0
-    assert body["summary"]["verdict"] == "advisories"
+    assert body["summary"]["verdict"] == "clean"
     assert body["summary"]["violations"] == 0
     assert len(body["findings"]) == body["summary"]["advisories"]
     assert body["liveness"]
 
 
-def test_cli_expect_drift_fails(tmp_path, capsys):
-    # a tampered expected list (one entry removed) must fail both ways
+def _regressed_library_graph(cfg):
+    """A stand-in production graph with one host materialization between
+    device nodes — the exact regression the empty expected list exists
+    to catch (the CLI re-imports the builder per call, so a monkeypatch
+    on the pipeline module reaches it)."""
+    b = GraphBuilder("library")
+    b.input("src", "disk")
+    b.edge("dev_a", "hbm")
+    b.edge("host_mat", "host")
+    b.edge("dev_b", "hbm")
+    b.edge("res", "host")
+    b.add_node(N_UP, inputs=("src",), outputs=("dev_a",))
+    b.add_node(N_HOSTWORK, inputs=("dev_a",), outputs=("host_mat",))
+    b.add_node(N_REUP, inputs=("host_mat",), outputs=("dev_b",))
+    b.add_node(N_SINK, inputs=("dev_b",), outputs=("res",))
+    b.result("res")
+    return b.build()
+
+
+def test_cli_expect_drift_fails(tmp_path, capsys, monkeypatch):
+    # the committed list is empty (device-resident data plane); a stale
+    # entry — e.g. a fixed round-trip someone left listed — must fail
     with open(DEFAULT_EXPECT, encoding="utf-8") as fh:
         expected = json.load(fh)
-    assert expected["findings"], "committed list unexpectedly empty"
-    tampered = dict(expected, findings=expected["findings"][1:])
-    p = tmp_path / "expect.json"
-    p.write_text(json.dumps(tampered))
-    assert graftcheck_main(["--expect", str(p)]) == 1
-    err = capsys.readouterr().err
-    assert "NEW finding not in the expected list" in err
-    # ...and the symmetric direction: an extra (bogus) expected entry
+    assert expected["findings"] == [], "committed list expected clean"
     bogus = dict(expected)
-    bogus["findings"] = expected["findings"] + [
+    bogus["findings"] = [
         {"kind": K_TRIP, "subject": "ghost", "path": ["ghost"]}
     ]
+    p = tmp_path / "expect.json"
     p.write_text(json.dumps(bogus))
     assert graftcheck_main(["--expect", str(p)]) == 1
     assert "no longer reported" in capsys.readouterr().err
+    # ...and the direction CI actually guards: a reintroduced host
+    # round-trip is a NEW finding vs the empty committed list and fails
+    # BY NAME
+    monkeypatch.setattr(
+        graph_pipeline, "build_library_graph", _regressed_library_graph)
+    p.write_text(json.dumps(expected))
+    assert graftcheck_main(["--expect", str(p)]) == 1
+    err = capsys.readouterr().err
+    assert "NEW finding not in the expected list" in err
+    assert N_REUP in err
 
 
 def test_cli_never_crashes_on_bad_inputs(tmp_path, capsys):
@@ -355,9 +379,9 @@ def test_summary_lands_in_telemetry_and_history_entry():
         telemetry = reg.summary()
     finally:
         metrics.disarm()
-    assert telemetry["analysis"]["graftcheck"]["verdict"] == "advisories"
+    assert telemetry["analysis"]["graftcheck"]["verdict"] == "clean"
     entry = history.build_entry("test", telemetry)
-    assert entry["graftcheck"]["verdict"] == "advisories"
+    assert entry["graftcheck"]["verdict"] == "clean"
     assert entry["graftcheck"]["violations"] == 0
     assert entry["graftcheck"]["hbm_high_water_node"] is not None
 

@@ -354,10 +354,12 @@ def test_telemetry_full_e2e_artifacts(telemetry_runs):
                for s in tr["sites"].values())
     assert tr["edges"], "executor edge materialization must be attributed"
     assert all(e["direction"] in ("h2d", "d2h") for e in tr["edges"].values())
-    assert isinstance(tr["host_round_trip_bytes"], int)
-    assert tr["host_round_trip_bytes"] >= 0
+    # the data plane is device-resident: zero round-trip edges statically
+    # (graftcheck) means zero bytes charged at runtime, and no donated
+    # edge may degrade to a host copy
+    assert tr["host_round_trip_bytes"] == 0
     verdicts = {d["verdict"] for d in tr.get("donation", {}).values()}
-    assert verdicts <= {"donated", "copied", "unknown"}
+    assert verdicts <= {"donated", "unknown"}
     assert tr["static_hbm_by_node"], "graftcheck liveness must be recorded"
     # and the history entry carries the roll-up for bench.py --gate
     assert entries[0]["transfer_bytes"]["d2h"] >= 0
