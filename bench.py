@@ -414,6 +414,10 @@ def main(argv=None) -> int:
             })
             pre = daemon.prewarm_report or {}
             daemon_extra = {
+                # cold start = daemon launch to "accepting jobs" (arm +
+                # journal resume + AOT prewarm): the ROADMAP-3 <=10s claim
+                "cold_start_s": (round(daemon.warmup_s, 3)
+                                 if daemon.warmup_s is not None else None),
                 "dispatch_first_stage_s": job2.get("first_stage_s"),
                 "prewarm_compiled": pre.get("compiled", 0),
                 "prewarm_failed": pre.get("failed", 0),
@@ -537,6 +541,18 @@ def main(argv=None) -> int:
         print(f"bench: transfer gate {transfer.status.upper()} — "
               f"{transfer.reason}", file=sys.stderr)
         if transfer.status == "fail":
+            rc = 1
+        # serving-SLO gate: the ledger's newest serve_load entry (the
+        # scripts/serve_load.py report) vs its own baseline pool; a
+        # ledger without load history WARNs — the bench entry under
+        # judgment is never a load report, so current=None here
+        load = obs_history.evaluate_load_gate(
+            baseline, None, rel_threshold=args.gate_threshold,
+            mad_k=args.gate_mad_k, min_samples=args.gate_min_samples,
+        )
+        print(f"bench: load gate {load.status.upper()} — {load.reason}",
+              file=sys.stderr)
+        if load.status == "fail":
             rc = 1
     if args.ledger:
         try:

@@ -97,8 +97,10 @@ OBS_SITES = frozenset({
     "serve.submitted",
     "serve.rejected",
     "serve.requeued",
+    "serve.retried",
     "serve.done",
     "serve.failed",
+    "serve.poisoned",
     "serve.queue_depth",
     "serve.wait_s",
     "serve.first_stage_s",

@@ -464,6 +464,103 @@ def evaluate_bytes_gate(entries: list[dict], current: dict, *,
     )
 
 
+def _load_metric(entry: dict, metric: str) -> float | None:
+    """A serve_load SLO metric of one entry; zero is valid (an idle p99
+    wait of 0s must gate)."""
+    v = entry.get(metric)
+    if isinstance(v, (int, float)) and not isinstance(v, bool) and v >= 0:
+        return float(v)
+    return None
+
+
+def evaluate_load_gate(entries: list[dict], current: dict | None = None, *,
+                       rel_threshold: float = 0.15, mad_k: float = 4.0,
+                       min_samples: int = 3) -> GateResult:
+    """Serving-SLO regression verdict over ``source:"serve_load"``
+    entries: p99 job wait (lower is better) and sustained reads_per_sec
+    (higher is better), each under the same median+MAD noise allowance
+    as :func:`evaluate_gate`.
+
+    ``current=None`` gates the NEWEST serve_load entry against the rest
+    (the perf-gate CLI path, where the latest ledger entry is usually a
+    run/bench entry); a ledger with no serve_load history degrades to
+    ``warn`` — the load gate arms only once a load report has been
+    recorded. A ``current`` whose source is not serve_load is never
+    load-gated (warn), keeping the verdict additive for existing
+    callers. Any gated metric failing fails the gate; otherwise any
+    gated metric passing passes it; all-thin stays warn.
+    """
+    pool = [e for e in entries if isinstance(e, dict)
+            and e.get("source") == "serve_load"]
+    if current is None:
+        if not pool:
+            return GateResult(
+                "warn", "no serve_load entries in the ledger — load gate "
+                "not armed (run scripts/serve_load.py --ledger to record "
+                "one)")
+        current = pool[-1]
+    elif current.get("source") != "serve_load":
+        return GateResult(
+            "warn", f"current entry source={current.get('source')!r} is "
+            "not serve_load — not load-gated")
+    baseline = matching_entries(pool, current)
+    verdicts: list[GateResult] = []
+    for metric, higher_better in (("p99_wait_s", False),
+                                  ("reads_per_sec", True)):
+        cur = _load_metric(current, metric)
+        if cur is None:
+            verdicts.append(GateResult(
+                "warn", f"current serve_load entry has no {metric} — "
+                "not gated", metric=metric))
+            continue
+        values = [v for e in baseline
+                  for v in (_load_metric(e, metric),) if v is not None]
+        if len(values) < min_samples:
+            verdicts.append(GateResult(
+                "warn",
+                f"thin ledger: {len(values)} matching serve_load baseline "
+                f"sample(s) with {metric} < min_samples={min_samples} — "
+                "recorded, not gated",
+                metric=metric, current=cur, n_baseline=len(values),
+            ))
+            continue
+        med = _median(values)
+        mad = _median([abs(v - med) for v in values])
+        allowance = max(rel_threshold * med, mad_k * MAD_SCALE * mad)
+        if higher_better:
+            regressed = cur < med - allowance
+            side = "below"
+        else:
+            regressed = cur > med + allowance
+            side = "above"
+        detail = (f"{metric}={cur:.3f} vs baseline median {med:.3f} "
+                  f"(MAD {mad:.3f}, allowance {allowance:.3f}, "
+                  f"{len(values)} sample(s))")
+        if regressed:
+            verdicts.append(GateResult(
+                "fail", f"serving regression: {detail} — current is "
+                f"{side} the noise allowance", metric=metric, current=cur,
+                baseline_median=med, baseline_mad=mad, allowance=allowance,
+                n_baseline=len(values),
+            ))
+        else:
+            verdicts.append(GateResult(
+                "pass", f"within noise allowance: {detail}", metric=metric,
+                current=cur, baseline_median=med, baseline_mad=mad,
+                allowance=allowance, n_baseline=len(values),
+            ))
+    for v in verdicts:
+        if v.status == "fail":
+            return v
+    passes = [v for v in verdicts if v.status == "pass"]
+    joined = "; ".join(v.reason for v in verdicts)
+    if passes:
+        return dataclasses.replace(passes[0], reason=joined)
+    return GateResult(
+        "warn", joined,
+        n_baseline=max((v.n_baseline for v in verdicts), default=0))
+
+
 # --- the run roll-up hook -----------------------------------------------------
 
 

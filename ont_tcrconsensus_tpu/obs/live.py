@@ -530,8 +530,21 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(400, {"error": "missing request body"})
                 return
             if length > MAX_JOB_BODY_BYTES:
+                metrics.counter_add("serve.rejected")
+                metrics.reject_add("body_too_large")
+                # drain the declared body before answering: responding
+                # while the client is still streaming resets the
+                # connection (EPIPE client-side) and the machine-readable
+                # 413 would be lost to a transport error
+                remaining = length
+                while remaining > 0:
+                    chunk = self.rfile.read(min(remaining, 1 << 16))
+                    if not chunk:
+                        break
+                    remaining -= len(chunk)
                 self._send_json(413, {
-                    "error": f"job body over {MAX_JOB_BODY_BYTES} bytes",
+                    "error": "body_too_large",
+                    "detail": f"job body over {MAX_JOB_BODY_BYTES} bytes",
                 })
                 return
             try:

@@ -39,6 +39,13 @@ class MetricsRegistry:
         self.t0_mono = time.monotonic()
         self.counters: dict[str, float] = {}
         self.gauges: dict[str, float] = {}  # high-water (max) semantics
+        # last-value (live) semantics: the serve queue depth NOW, not its
+        # high-water — /metrics scrapes between jobs must see it fall
+        self.gauges_live: dict[str, float] = {}
+        # serve-plane rejection reason -> count (queue_full / over_budget /
+        # invalid_config / draining / body_too_large); its own table
+        # because the reason is a label dimension, not an OBS_SITES site
+        self.serve_rejects: dict[str, float] = {}
         # site -> [count, sum, min, max]
         self.hists: dict[str, list[float]] = {}
         # name -> [seconds, calls]
@@ -94,6 +101,19 @@ class MetricsRegistry:
         with self._lock:
             if value > self.gauges.get(site, float("-inf")):
                 self.gauges[site] = value
+
+    def gauge_set(self, site: str, value: float) -> None:
+        """Live gauge: last value wins AND the high-water table keeps its
+        max, so one plant feeds both the /metrics live view and the
+        telemetry.json high-water roll-up."""
+        with self._lock:
+            self.gauges_live[site] = value
+            if value > self.gauges.get(site, float("-inf")):
+                self.gauges[site] = value
+
+    def reject_add(self, reason: str, n: float = 1) -> None:
+        with self._lock:
+            self.serve_rejects[reason] = self.serve_rejects.get(reason, 0) + n
 
     def observe(self, site: str, value: float) -> None:
         with self._lock:
@@ -258,6 +278,13 @@ class MetricsRegistry:
                 },
                 "counters": {k: self.counters[k] for k in sorted(self.counters)},
                 "gauges": {k: self.gauges[k] for k in sorted(self.gauges)},
+                **({"gauges_live": {k: self.gauges_live[k]
+                                    for k in sorted(self.gauges_live)}}
+                   if self.gauges_live else {}),
+                **({"serve_rejected_by_reason": {
+                        k: int(self.serve_rejects[k])
+                        for k in sorted(self.serve_rejects)}}
+                   if self.serve_rejects else {}),
                 "histograms": {
                     k: {"count": int(v[0]), "sum": round(v[1], 3),
                         "min": v[2], "max": v[3]}
@@ -378,6 +405,16 @@ class MetricsRegistry:
             fam(lines, "tcr_gauge", "gauge",
                 "High-water gauges (HBM in use, host RSS, ...).",
                 [("site", k, self.gauges[k]) for k in sorted(self.gauges)])
+            fam(lines, "tcr_gauge_current", "gauge",
+                "Live last-value gauges (serve queue depth NOW, not its "
+                "high-water).",
+                [("site", k, self.gauges_live[k])
+                 for k in sorted(self.gauges_live)])
+            fam(lines, "tcr_serve_rejected_total", "counter",
+                "Serve-plane job rejections by reason (queue_full / "
+                "over_budget / invalid_config / draining / body_too_large).",
+                [("reason", k, self.serve_rejects[k])
+                 for k in sorted(self.serve_rejects)])
             for i, (suffix, help_) in enumerate((
                 ("count", "Histogram observation counts."),
                 ("sum", "Histogram observation sums."),
@@ -497,6 +534,8 @@ def prom_label(value: str) -> str:
 LOCK_OWNERSHIP = {
     "MetricsRegistry.counters": "_lock",
     "MetricsRegistry.gauges": "_lock",
+    "MetricsRegistry.gauges_live": "_lock",
+    "MetricsRegistry.serve_rejects": "_lock",
     "MetricsRegistry.hists": "_lock",
     "MetricsRegistry.stages": "_lock",
     "MetricsRegistry.dispatch": "_lock",
@@ -559,6 +598,22 @@ def observe(site: str, value: float) -> None:
     reg = _ARMED
     if reg is not None:
         reg.observe(site, value)
+
+
+def gauge_set(site: str, value: float) -> None:
+    """Record a live (last-value) gauge; free no-op when telemetry is off."""
+    reg = _ARMED
+    if reg is not None:
+        reg.gauge_set(site, value)
+
+
+def reject_add(reason: str, n: float = 1) -> None:
+    """Count a serve-plane rejection under ``reason``; free no-op when
+    telemetry is off. The argument is a label value, not an OBS_SITES
+    site — the per-site serve.rejected counter is planted separately."""
+    reg = _ARMED
+    if reg is not None:
+        reg.reject_add(reason, n)
 
 
 def graph_node_add(name: str, *, critical_s: float = 0.0,

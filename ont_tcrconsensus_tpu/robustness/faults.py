@@ -25,6 +25,24 @@ site                     planted at
 ``graph.node``           every critical-path node body under the graph
                          executor (graph/executor.py) — the per-node
                          generalization of the hand-placed sites
+``serve.job_run``        the daemon's per-job dispatch, immediately before
+                         the pipeline runs (serve/daemon.py) — the
+                         job-crash drill behind the bounded-retry /
+                         poison-quarantine ladder
+``serve.job_slow``       a slow tenant job: fires a ``stall`` inside a
+                         serve-level watchdog guard (serve/daemon.py), so
+                         the cancel classifies transient and the job
+                         retries instead of wedging the loop
+``serve.daemon_loop``    the serve accept loop between pop and dispatch
+                         (serve/daemon.py) — an ``error`` here escapes the
+                         loop: the drain finally still journals the queue
+                         and flushes the flight recorder, simulating a
+                         daemon crash mid-load
+``serve.journal_write``  the drain-journal commit (serve/queue.py) —
+                         ``torn`` tears the journal mid-write
+``serve.prewarm``        the AOT bucket prewarm (serve/daemon.py) — a
+                         failed prewarm must degrade to a report line,
+                         never a dead daemon
 ======================== ====================================================
 
 Fault kinds:
@@ -99,6 +117,11 @@ KNOWN_SITES = frozenset({
     "ingest.library_fastq",
     "resume.verify",
     "graph.node",
+    "serve.job_run",
+    "serve.job_slow",
+    "serve.daemon_loop",
+    "serve.journal_write",
+    "serve.prewarm",
 })
 
 KILL_EXIT_CODE = 137

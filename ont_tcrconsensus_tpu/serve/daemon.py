@@ -43,7 +43,10 @@ from ont_tcrconsensus_tpu.obs import history as obs_history
 from ont_tcrconsensus_tpu.obs import live as obs_live
 from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
 from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+from ont_tcrconsensus_tpu.robustness import faults
+from ont_tcrconsensus_tpu.robustness import retry as retry_mod
 from ont_tcrconsensus_tpu.robustness import shutdown
+from ont_tcrconsensus_tpu.robustness import watchdog as watchdog_mod
 from ont_tcrconsensus_tpu.serve import prewarm as prewarm_mod
 from ont_tcrconsensus_tpu.serve import queue as queue_mod
 
@@ -91,6 +94,14 @@ class Daemon:
             else self.template_cfg.serve_queue_max,
             self.budget,
         )
+        # bounded per-job retry, from the SAME config knobs the batch
+        # path's stage retries use — transient failures requeue with
+        # backoff, anything else (or exhaustion) poison-quarantines
+        self.retry_policy = retry_mod.RetryPolicy(
+            max_attempts=self.template_cfg.retry_max_attempts,
+            base_delay_s=self.template_cfg.retry_base_delay_s,
+            max_delay_s=2.0, seed=0,
+        )
         self.prewarm_report: dict | None = None
         self.warmup_s: float | None = None
         self.jobs_done = 0
@@ -102,10 +113,15 @@ class Daemon:
     # --- jobs controller (HTTP handler threads) ----------------------------
 
     def submit(self, overrides: dict) -> tuple[int, dict]:
-        if self._draining.is_set() or self._stop.is_set():
-            return 503, {"error": "draining",
-                         "detail": "daemon is draining; resubmit after "
-                                   "restart (queued jobs are journaled)"}
+        if (self._draining.is_set() or self._stop.is_set()
+                or self._coord.requested()):
+            # the SIGTERM window counts too: between the signal and the
+            # loop's exit the in-flight job is still draining, and a job
+            # accepted now would only journal — refuse it honestly
+            err = self.queue.reject(
+                "draining", "daemon is draining; resubmit after restart "
+                            "(queued jobs are journaled)")
+            return 503, {"error": err.reason, "detail": err.detail}
         merged = dict(self.template)
         merged.update(overrides)
         # the daemon owns the live plane; a job must not re-point it
@@ -174,6 +190,19 @@ class Daemon:
             self.prewarm_report = {"skipped": "serve_prewarm off",
                                    "entries": [], "seconds": 0.0}
             return
+        try:
+            faults.inject("serve.prewarm")
+            self._prewarm_inner()
+        except Exception as exc:
+            # prewarm is an optimization, never a gate: a failure degrades
+            # to lazy first-job compiles and the daemon stays up
+            self.prewarm_report = {"error": repr(exc), "entries": [],
+                                   "seconds": 0.0, "failed": 1}
+            obs_metrics.analysis_set("serve_prewarm", self.prewarm_report)
+            _log(f"WARNING: prewarm failed ({exc!r}); first job with each "
+                 "shape compiles lazily")
+
+    def _prewarm_inner(self) -> None:
         from ont_tcrconsensus_tpu.cluster import regions as regions_mod
         from ont_tcrconsensus_tpu.io import fastx
         from ont_tcrconsensus_tpu.pipeline import run as run_mod
@@ -208,6 +237,10 @@ class Daemon:
 
         cache_state = run_mod.enable_compilation_cache(
             self.template_cfg.compile_cache_dir)
+        # serve-scope chaos: TCR_CHAOS arms drills that fire in the daemon
+        # loop itself (each job's run re-declares its own chaos state, so
+        # a per-run env plan still fires inside jobs as before)
+        faults.arm_from_env()
         obs_metrics.arm()
         obs_metrics.analysis_set("compile_cache", cache_state)
         srv = obs_live.arm(self.port)
@@ -221,6 +254,7 @@ class Daemon:
              f"(/jobs /healthz /metrics /progress; pid {os.getpid()}"
              f"{'' if installed else '; cooperative stop only'})")
         exit_code = 0
+        crash: BaseException | None = None
         try:
             self._resume_journal()
             self._prewarm()
@@ -235,6 +269,14 @@ class Daemon:
                 job = self.queue.pop(timeout=0.25)
                 if job is None:
                     continue
+                try:
+                    # loop-crash drill: the popped job must not vanish —
+                    # requeue it so the drain journal in `finally` (and a
+                    # restarted daemon) still carries it
+                    faults.inject("serve.daemon_loop")
+                except BaseException:
+                    self.queue.requeue_front(job)
+                    raise
                 if self._coord.requested() or self._stop.is_set():
                     # drained between pop and dispatch: back on the head
                     self.queue.requeue_front(job)
@@ -243,16 +285,28 @@ class Daemon:
                 if not self._run_job(job):
                     exit_code = 143
                     break
+        except BaseException as exc:
+            crash = exc
+            raise
         finally:
             self._draining.set()
             drained = self.queue.drain_jobs()
             path = queue_mod.write_journal(self.state_dir, drained)
             if path:
                 _log(f"drain: journaled {len(drained)} job(s) to {path}")
-            obs_live.flush_armed("serve_drain")
+            # a crash flushes the flight recorder under a reason naming
+            # the exception type, so the black box says WHY it died; each
+            # job's run re-pointed the flush path into its own output
+            # tree, so re-claim the daemon's before flushing
+            obs_live.set_flush_path(os.path.join(
+                self.state_dir, "logs", "flight_recorder.json"))
+            obs_live.flush_armed(
+                "serve_drain" if crash is None
+                else f"serve_crash:{type(crash).__name__}")
             obs_live.set_jobs_controller(None)
             obs_live.disarm()
             obs_metrics.disarm()
+            faults.disarm()
             shutdown.deactivate(self._coord)
             self._coord.uninstall()
         return exit_code
@@ -277,6 +331,7 @@ class Daemon:
         obs_live.set_node_start_hook(first_stage_hook)
         outcome = _JobOutcome("done")
         try:
+            self._inject_job_chaos(job, cfg)
             results = run_mod.run_with_config(cfg)
             outcome.result = {
                 "libraries": {
@@ -296,13 +351,16 @@ class Daemon:
                  f"resume=true")
             return False
         except Exception as exc:
-            outcome = _JobOutcome("failed", error=repr(exc))
+            outcome = self._failure_outcome(job, exc)
         finally:
             obs_live.set_node_start_hook(None)
             # the job's run disarmed its registry on exit; re-arm a fresh
             # daemon-scope one so between-job /metrics scrapes stay live
             obs_metrics.arm()
-            obs_metrics.gauge_max("serve.queue_depth", self.queue.depth())
+            obs_metrics.gauge_set("serve.queue_depth", self.queue.depth())
+        if outcome.state == "retry":
+            # back in the queue with backoff — not terminal, not counted
+            return True
         job_s = time.monotonic() - t_dispatch
         self.queue.mark(job, outcome.state, error=outcome.error,
                         result=outcome.result)
@@ -317,8 +375,65 @@ class Daemon:
                  if job.first_stage_s is not None else
                  f"{job.id}: done in {job_s:.3f}s")
         else:
-            _log(f"{job.id}: failed: {outcome.error}")
+            _log(f"{job.id}: {outcome.state}: {outcome.error}")
         return True
+
+    def _inject_job_chaos(self, job: queue_mod.Job, cfg: RunConfig) -> None:
+        """Serve-plane chaos plants, free no-ops when disarmed.
+
+        ``serve.job_run`` raises a seeded failure before dispatch (the
+        retry/poison ladder's entry point); ``serve.job_slow`` stalls
+        under a short-lived serve-scope watchdog armed only for the drill
+        (``stage_timeout_s`` template knob), so cancel -> StageTimeout ->
+        transient classification -> requeue is exercised end to end.
+        """
+        if not faults.active():
+            return
+        faults.inject("serve.job_run")
+        if cfg.stage_timeout_s:
+            wd = watchdog_mod.Watchdog(cfg.stage_timeout_s)
+            wd.start()
+            watchdog_mod.activate(wd)
+            try:
+                with wd.guard(f"serve:{job.id}"):
+                    faults.inject("serve.job_slow")
+            finally:
+                watchdog_mod.deactivate(wd)
+                wd.stop()
+        else:
+            faults.inject("serve.job_slow")
+
+    def _failure_outcome(self, job: queue_mod.Job,
+                         exc: Exception) -> _JobOutcome:
+        """The retry/poison ladder. Transient failures requeue with
+        seeded backoff up to ``retry_max_attempts``; anything fatal — or
+        a transient that exhausts its attempts — is quarantined durably
+        to ``serve_poison.json`` with a machine-readable reason, so one
+        bad tenant job can never wedge the loop."""
+        job.attempts += 1
+        cls = retry_mod.classify(exc)
+        if (cls == "transient"
+                and job.attempts < self.retry_policy.max_attempts):
+            delay = self.retry_policy.delay(job.attempts)
+            retry_mod.recorder().record(
+                "serve.job_run", classification=cls, outcome="retry",
+                attempt=job.attempts, error=repr(exc))
+            self.queue.requeue_back(job, delay_s=delay)
+            obs_live.ring_event("serve.job", {
+                "id": job.id, "event": "retry", "attempt": job.attempts})
+            _log(f"{job.id}: transient failure (attempt {job.attempts}/"
+                 f"{self.retry_policy.max_attempts}): {exc!r}; requeued "
+                 f"with {delay:.2f}s backoff")
+            return _JobOutcome("retry")
+        reason = "retry_exhausted" if cls == "transient" else cls
+        retry_mod.recorder().record(
+            "serve.job_run", classification=cls, outcome="poisoned",
+            attempt=job.attempts, error=repr(exc))
+        path = queue_mod.append_poison(
+            self.state_dir, job, classification=reason, error=repr(exc))
+        _log(f"{job.id}: poisoned ({reason}) after {job.attempts} "
+             f"attempt(s): {exc!r}; quarantined to {path}")
+        return _JobOutcome("poisoned", error=f"{reason}: {exc!r}")
 
     def _record_ledger(self, job: queue_mod.Job, cfg: RunConfig,
                        job_s: float) -> None:

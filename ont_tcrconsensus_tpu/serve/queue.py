@@ -25,15 +25,19 @@ import dataclasses
 import itertools
 import json
 import os
+import sys
 import threading
 import time
 
 from ont_tcrconsensus_tpu.io import bucketing
 from ont_tcrconsensus_tpu.obs import metrics
 from ont_tcrconsensus_tpu.parallel.budget import BudgetModel
+from ont_tcrconsensus_tpu.robustness import faults
 
 JOURNAL_SCHEMA = 1
 JOURNAL_BASENAME = "serve_journal.json"
+POISON_SCHEMA = 1
+POISON_BASENAME = "serve_poison.json"
 
 #: jobs remembered after they leave the queue (done/failed/rejected) so
 #: ``GET /jobs/<id>`` keeps answering; oldest-first eviction past this
@@ -56,8 +60,12 @@ class Job:
 
     ``raw`` is the tenant's JSON object as submitted (merged over the
     daemon's template config at run time); lifecycle timestamps are wall
-    seconds. States: queued -> running -> done | failed; requeued (drain
-    journaled the job mid-queue; resumes with ``resume=true`` forced).
+    seconds. States: queued -> running -> done | failed | poisoned;
+    requeued (drain journaled the job mid-queue; resumes with
+    ``resume=true`` forced). ``attempts`` counts executions for the
+    retry/poison ladder; ``not_before`` (monotonic seconds) is the retry
+    backoff gate the pop side respects — neither survives the drain
+    journal, so a restart retries a carried job from attempt 0.
     """
 
     id: str
@@ -70,6 +78,8 @@ class Job:
     result: dict | None = None
     wait_s: float | None = None
     first_stage_s: float | None = None
+    attempts: int = 0
+    not_before: float = 0.0
 
     def snapshot(self) -> dict:
         return {
@@ -155,9 +165,11 @@ class JobQueue:
         with self._lock:
             if not ok:
                 metrics.counter_add("serve.rejected")
+                metrics.reject_add("over_budget")
                 raise AdmissionError("over_budget", detail)
             if len(self.pending) >= self.max_depth:
                 metrics.counter_add("serve.rejected")
+                metrics.reject_add("queue_full")
                 raise AdmissionError(
                     "queue_full",
                     f"queue depth {len(self.pending)} at serve_queue_max="
@@ -168,7 +180,7 @@ class JobQueue:
             self.pending.append(job)
             self.jobs[job.id] = job
             metrics.counter_add("serve.submitted")
-            metrics.gauge_max("serve.queue_depth", len(self.pending))
+            metrics.gauge_set("serve.queue_depth", len(self.pending))
             self._nonempty.notify()
             return job
 
@@ -176,23 +188,41 @@ class JobQueue:
         """Count + build an admission error for daemon-side rejections
         (invalid config, draining) so every refusal path meters alike."""
         metrics.counter_add("serve.rejected")
+        metrics.reject_add(reason)
         return AdmissionError(reason, detail)
 
     # --- pop side (daemon loop) -------------------------------------------
 
     def pop(self, timeout: float | None = None) -> Job | None:
-        """Next job in FIFO order (state -> running), or None on timeout."""
+        """Next ELIGIBLE job in FIFO order (state -> running), or None on
+        timeout. A job whose retry backoff (``not_before``) has not
+        elapsed is skipped — later arrivals run ahead of it, so one
+        backing-off job never stalls the loop; among eligible jobs order
+        stays strictly FIFO."""
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
-            if not self.pending and not self._nonempty.wait(timeout):
-                return None
-            if not self.pending:  # woken by requeue_front during drain
-                return None
-            job = self.pending.pop(0)
-            job.state = "running"
-            job.started_t = time.time()
-            job.wait_s = job.started_t - job.submitted_t
-            metrics.observe("serve.wait_s", job.wait_s)
-            return job
+            while True:
+                now = time.monotonic()
+                idx = next((i for i, j in enumerate(self.pending)
+                            if j.not_before <= now), None)
+                if idx is not None:
+                    job = self.pending.pop(idx)
+                    job.state = "running"
+                    job.started_t = time.time()
+                    job.wait_s = job.started_t - job.submitted_t
+                    metrics.observe("serve.wait_s", job.wait_s)
+                    metrics.gauge_set("serve.queue_depth", len(self.pending))
+                    return job
+                wait = None if deadline is None else deadline - now
+                if wait is not None and wait <= 0:
+                    return None
+                if self.pending:
+                    # everything queued is backing off: sleep only until
+                    # the earliest gate opens (or the caller's timeout)
+                    gate = min(j.not_before for j in self.pending) - now
+                    gate = max(gate, 0.005)
+                    wait = gate if wait is None else min(wait, gate)
+                self._nonempty.wait(wait)
 
     def requeue_front(self, job: Job) -> None:
         """Put a drained in-flight job back at the head (state ->
@@ -201,11 +231,25 @@ class JobQueue:
             job.state = "requeued"
             metrics.counter_add("serve.requeued")
             self.pending.insert(0, job)
+            metrics.gauge_set("serve.queue_depth", len(self.pending))
+            self._nonempty.notify()
+
+    def requeue_back(self, job: Job, *, delay_s: float = 0.0) -> None:
+        """Put a transiently-failed job back at the tail with a retry
+        backoff (state -> queued); the pop side skips it until
+        ``not_before`` so other tenants' jobs run in the meantime."""
+        with self._lock:
+            job.state = "queued"
+            job.not_before = time.monotonic() + max(float(delay_s), 0.0)
+            metrics.counter_add("serve.retried")
+            self.pending.append(job)
+            metrics.gauge_set("serve.queue_depth", len(self.pending))
             self._nonempty.notify()
 
     def mark(self, job: Job, state: str, *, error: str | None = None,
              result: dict | None = None) -> None:
-        """Terminal transition (done/failed) + bounded finished memory."""
+        """Terminal transition (done/failed/poisoned) + bounded finished
+        memory."""
         with self._lock:
             job.state = state
             job.finished_t = time.time()
@@ -213,6 +257,8 @@ class JobQueue:
             job.result = result
             if state == "done":
                 metrics.counter_add("serve.done")
+            elif state == "poisoned":
+                metrics.counter_add("serve.poisoned")
             else:
                 metrics.counter_add("serve.failed")
             self.finished_order.append(job.id)
@@ -277,29 +323,106 @@ def write_journal(state_dir: str, jobs: list[Job]) -> str | None:
             for j in jobs
         ],
     }
+    payload_s = json.dumps(payload, indent=1)
+    if faults.tear_write("serve.journal_write", path, payload_s):
+        return path  # chaos: half the payload hit the final path directly
+    # tmp + fsync + rename (io/layout.py manifest discipline): a crash
+    # mid-write must leave either the old journal or the new one, never
+    # a torn file — these are accepted tenant jobs
     tmp = path + ".tmp"
     with open(tmp, "w") as fh:
-        json.dump(payload, fh, indent=1)
+        fh.write(payload_s)
+        fh.flush()
+        os.fsync(fh.fileno())
     os.replace(tmp, path)
     return path
 
 
+def _quarantine_torn(path: str, why: str) -> None:
+    """Named degradation for an unreadable drain journal: warn once on
+    stderr with a greppable prefix and move the file aside (``.bad``) so
+    the evidence survives but the next restart doesn't re-trip."""
+    print(f"serve: WARNING: torn/unreadable drain journal {path}: {why}; "
+          f"quarantined to {os.path.basename(path)}.bad — starting with "
+          "an empty queue", file=sys.stderr)
+    try:
+        os.replace(path, path + ".bad")
+    except OSError:
+        pass
+
+
 def load_journal(state_dir: str) -> list[dict]:
     """Read + consume the drain journal: entries in resume order, the
-    file removed (its content now lives in the daemon's queue). Garbage
-    degrades to an empty list — a torn journal must not wedge restarts."""
+    file removed (its content now lives in the daemon's queue). Torn or
+    garbage payloads degrade to a named warning + empty list with the
+    file quarantined to ``*.bad`` — a torn journal must not wedge
+    restarts."""
     path = journal_path(state_dir)
     try:
         with open(path) as fh:
             payload = json.load(fh)
-    except (OSError, ValueError):
+    except FileNotFoundError:
+        return []
+    except (OSError, ValueError) as exc:
+        _quarantine_torn(path, repr(exc))
+        return []
+    jobs = payload.get("jobs") if isinstance(payload, dict) else None
+    if not isinstance(jobs, list):
+        _quarantine_torn(path, "payload is not {schema, jobs: [...]}")
         return []
     try:
         os.remove(path)
     except OSError:
         pass
+    return [j for j in jobs if isinstance(j, dict) and isinstance(
+        j.get("raw"), dict)]
+
+
+# --- poison quarantine --------------------------------------------------------
+
+
+def poison_path(state_dir: str) -> str:
+    return os.path.join(state_dir, POISON_BASENAME)
+
+
+def append_poison(state_dir: str, job: Job, *, classification: str,
+                  error: str) -> str:
+    """Quarantine a job that exhausted its retries (or failed fatally) to
+    ``serve_poison.json`` with a machine-readable reason. Atomic
+    read-modify-replace under the daemon loop (single writer), so one
+    bad tenant job is recorded durably and never re-enters the queue."""
+    path = poison_path(state_dir)
+    os.makedirs(state_dir, exist_ok=True)
+    entries = load_poison(state_dir)
+    entries.append({
+        "id": job.id,
+        "raw": job.raw,
+        "classification": classification,
+        "error": error,
+        "attempts": int(job.attempts),
+        "submitted_t": round(job.submitted_t, 3),
+        "t_wall": round(time.time(), 3),
+    })
+    payload_s = json.dumps({"schema": POISON_SCHEMA, "jobs": entries},
+                           indent=1)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(payload_s)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def load_poison(state_dir: str) -> list[dict]:
+    """Poison-quarantine entries (non-consuming — the file is the durable
+    record); garbage degrades to an empty list."""
+    try:
+        with open(poison_path(state_dir)) as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError):
+        return []
     jobs = payload.get("jobs") if isinstance(payload, dict) else None
     if not isinstance(jobs, list):
         return []
-    return [j for j in jobs if isinstance(j, dict) and isinstance(
-        j.get("raw"), dict)]
+    return [j for j in jobs if isinstance(j, dict)]

@@ -23,6 +23,12 @@ allowed bytes. Entries predating the transfer ledger simply lack the
 field — they are skipped for the byte pool (WARN when it goes thin,
 never a crash) while remaining full baselines for the timing gate.
 
+A third additive verdict gates the serving SLOs over the ledger's
+``source:"serve_load"`` entries (scripts/serve_load.py reports): p99
+job wait (lower better) and sustained reads_per_sec (higher better),
+same allowance arithmetic. Ledgers with no load history WARN — the
+load gate arms once a load report has been recorded.
+
 Usage:
     python scripts/perf_gate.py LEDGER.jsonl [--current latest|entry.json]
         [--threshold 0.15] [--mad-k 4.0] [--min-samples 3] [--json]
@@ -108,17 +114,28 @@ def main(argv: list[str] | None = None) -> int:
         mad_k=args.mad_k, min_samples=args.min_samples,
         abs_budget=args.rt_budget,
     )
+    # serving-SLO verdict: gate the current entry when it IS a load
+    # report, else the ledger's newest serve_load entry (warn when none)
+    load = history.evaluate_load_gate(
+        entries,
+        current if current.get("source") == "serve_load" else None,
+        rel_threshold=args.threshold, mad_k=args.mad_k,
+        min_samples=args.min_samples,
+    )
     if args.json:
         # one JSON object on stdout (consumers json.loads the whole
-        # stream); the transfer verdict rides an additive key
+        # stream); the transfer + load verdicts ride additive keys
         body = dataclasses.asdict(result)
         body["transfer"] = dataclasses.asdict(transfer)
+        body["load"] = dataclasses.asdict(load)
         print(json.dumps(body, sort_keys=True))
     else:
         print(f"perf_gate: {result.status.upper()} — {result.reason}")
         print(f"perf_gate: transfer {transfer.status.upper()} — "
               f"{transfer.reason}")
-    return 1 if "fail" in (result.status, transfer.status) else 0
+        print(f"perf_gate: load {load.status.upper()} — {load.reason}")
+    return 1 if "fail" in (result.status, transfer.status,
+                           load.status) else 0
 
 
 if __name__ == "__main__":

@@ -31,7 +31,11 @@
 # (one warm daemon serves two HTTP-submitted jobs — the second with ZERO
 # steady-state compiles and outputs byte-identical to the one-shot CLI —
 # plus the slow-marked drain e2e: SIGTERM-equivalent stop mid-queue ->
-# journal -> restarted daemon resumes both jobs to correct counts).
+# journal -> restarted daemon resumes both jobs to correct counts), and a
+# serve-load smoke (scripts/serve_load.py seeded burst against an
+# in-process stub daemon: exact per-reason rejection accounting,
+# saturation 429s, a mid-drain 503, journal resume-to-completion, and a
+# schema-valid load_report.json).
 
 set -o pipefail
 cd "$(dirname "$0")/.."
@@ -196,5 +200,43 @@ drc=$?
 if [ "$drc" -ne 0 ]; then
     echo "daemon smoke FAILED (rc=$drc)" >&2
     exit "$drc"
+fi
+
+echo "--- serve load smoke (scripts/serve_load.py: seeded burst against an"
+echo "    in-process stub daemon — every 429/413/400/503 accounted exactly,"
+echo "    queue saturation refused with exact queue_full counts, mid-drain"
+echo "    submission 503s, journal -> restarted daemon completes every"
+echo "    accepted job, load_report.json schema-valid) ---"
+load_tmp=$(mktemp -d)
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/serve_load.py \
+    --scenario smoke --runner stub --seed 7 --period-s 0.4 \
+    --stub-job-s 0.02 --queue-max 2 --burst 4 \
+    --workdir "$load_tmp/state" --out "$load_tmp/load_report.json"
+lsrc=$?
+if [ "$lsrc" -ne 0 ]; then
+    echo "serve load smoke FAILED (rc=$lsrc)" >&2
+    rm -rf "$load_tmp"
+    exit "$lsrc"
+fi
+python - "$load_tmp/load_report.json" <<'EOF'
+import json, sys
+sys.path.insert(0, "scripts")
+import serve_load
+report = json.load(open(sys.argv[1]))
+assert serve_load.validate_report(report) == [], "load report schema"
+assert report["invariants"] == [], report["invariants"]
+sat = report["drills"]["saturation"]
+assert sat["queue_full_429"] == sat["expected_429"] >= 1, sat
+assert report["drills"]["mid_drain_503"] == 1, "mid-drain submit not 503"
+resume = report["drills"]["resume"]
+assert resume["journal_consumed"], "journal not consumed on restart"
+assert resume["completed_after_restart"] == report["drills"]["drain"][
+    "journaled"], "journaled jobs did not all complete after restart"
+EOF
+lvrc=$?
+rm -rf "$load_tmp"
+if [ "$lvrc" -ne 0 ]; then
+    echo "serve load report verification FAILED (rc=$lvrc)" >&2
+    exit "$lvrc"
 fi
 echo "tier-1 OK"

@@ -47,6 +47,9 @@ RULES = {
 
 _PLANT_FUNCS = {
     "counter_add", "gauge_max", "observe",  # obs.metrics
+    "gauge_set",                            # obs.metrics (live last-value
+    # gauges — serve queue depth; reject_add is NOT here because its
+    # argument is a rejection reason label, not an OBS_SITES site)
     "pool_add",                             # obs.metrics (worker-pool
     # busy/idle split, planted by pipeline.overlap.StageExecutor)
     "span", "instant",                      # obs.trace
