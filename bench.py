@@ -119,7 +119,28 @@ def build_dataset(root: str, seed: int = 33):
     return lib
 
 
-def run_once(root: str, live_port: int | None = None):
+def parse_mesh_spec(spec: str) -> dict[str, int]:
+    """``"data=8"`` / ``"data=4,model=2"`` -> {"data": 8, "model": 2}.
+
+    The axis order is preserved (it is the mesh's device-grid order);
+    values must be positive ints and a ``data`` axis is required — the
+    bench's sharded arm is the data-parallel scaling story.
+    """
+    shape: dict[str, int] = {}
+    for part in spec.split(","):
+        if "=" not in part:
+            raise ValueError(f"--mesh axis {part!r} is not name=N")
+        name, _, val = part.partition("=")
+        n = int(val)
+        if n < 1:
+            raise ValueError(f"--mesh axis {name!r} size {n} must be >= 1")
+        shape[name.strip()] = n
+    if "data" not in shape:
+        raise ValueError(f"--mesh {spec!r} needs a 'data' axis")
+    return shape
+
+
+def run_once(root: str, live_port: int | None = None, mesh_shape=None):
     from ont_tcrconsensus_tpu.pipeline.config import RunConfig
     from ont_tcrconsensus_tpu.pipeline.run import run_with_config
 
@@ -134,6 +155,8 @@ def run_once(root: str, live_port: int | None = None):
     }
     if live_port is not None:
         raw["live_port"] = live_port
+    if mesh_shape:
+        raw["mesh_shape"] = dict(mesh_shape)
     cfg = RunConfig.from_dict(raw)
     t0 = time.time()
     results = run_with_config(cfg)
@@ -323,6 +346,18 @@ def parse_args(argv=None):
         "runs: /healthz, /metrics, /progress on 127.0.0.1:PORT (0 = "
         "ephemeral) — lets an operator watch a long TPU capture mid-flight",
     )
+    ap.add_argument(
+        "--mesh", default=None, metavar="SPEC",
+        help="run the pipeline sharded over a device mesh, e.g. "
+        "'data=8' or 'data=4,model=2' (parallel/mesh.py): batches split "
+        "over the data axis, counts stay identical to the single-device "
+        "run. Without enough physical devices the needed count is forced "
+        "via XLA_FLAGS --xla_force_host_platform_device_count (virtual "
+        "CPU devices — relative scaling only). The mesh config lands as "
+        "'mesh_config' in the JSON line and the ledger entry, so per-"
+        "mesh scaling history gates only against its own shape. "
+        "Ignored by --daemon.",
+    )
     ap.add_argument("--gate-threshold", type=float, default=0.15)
     ap.add_argument("--gate-mad-k", type=float, default=4.0)
     ap.add_argument("--gate-min-samples", type=int, default=3)
@@ -344,6 +379,25 @@ def main(argv=None) -> int:
         print("bench: --gate needs a ledger (--ledger or BENCH_HISTORY)",
               file=sys.stderr)
         return 2
+    mesh_shape = None
+    if args.mesh:
+        mesh_shape = parse_mesh_spec(args.mesh)
+        if args.daemon:
+            print("bench: --daemon ignores --mesh", file=sys.stderr)
+            mesh_shape = None
+        else:
+            # the device-count force must land in the environment BEFORE
+            # any jax import in this process (the flag is read at backend
+            # init); harmless on a real multi-chip backend, and exactly
+            # how tests/conftest.py builds its virtual 8-device mesh
+            total = 1
+            for n in mesh_shape.values():
+                total *= n
+            flags = os.environ.get("XLA_FLAGS", "")
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={total}"
+            ).strip()
+            print(f"bench: sharded arm, mesh {mesh_shape}", file=sys.stderr)
     # Probe FIRST so a dead backend yields a diagnosable artifact (rc=0,
     # "tpu_unavailable") instead of a stack trace after minutes of setup.
     # BENCH_FORCE_CPU=1 is a dev-only escape hatch for relative timing when
@@ -424,8 +478,10 @@ def main(argv=None) -> int:
                 "prewarm_seconds": pre.get("seconds", 0.0),
             }
         else:
-            _, warm_dt, _ = run_once(root, live_port=args.live_port)
-            results, dt, cfg = run_once(root, live_port=args.live_port)
+            _, warm_dt, _ = run_once(root, live_port=args.live_port,
+                                     mesh_shape=mesh_shape)
+            results, dt, cfg = run_once(root, live_port=args.live_port,
+                                        mesh_shape=mesh_shape)
     except Exception as exc:  # backend died mid-run: still record a JSON line
         import traceback
 
@@ -459,6 +515,10 @@ def main(argv=None) -> int:
                   "warmup_s": round(warm_dt, 3), "steady_s": round(dt, 3)}
     if daemon_extra is not None:
         emit_extra["daemon"] = daemon_extra
+    if mesh_shape:
+        from ont_tcrconsensus_tpu.obs import history as _h
+
+        emit_extra["mesh_config"] = _h.mesh_config_str(mesh_shape)
     # cross-run keys (obs/history.py): the committed BENCH_*.json line and
     # the history ledger share one schema, so a capture file IS a valid
     # baseline entry and trend scripts need no translation layer
@@ -512,7 +572,11 @@ def main(argv=None) -> int:
         sha=sha, backend=backend, n_reads=n_reads,
         reads_per_sec=round(reads_per_sec, 2),
         warmup_s=warm_dt, steady_s=dt,
-        extra={"counts_exact": counts_ok, "duration_s": round(dt, 3)},
+        extra={"counts_exact": counts_ok, "duration_s": round(dt, 3),
+               # per-mesh-config scaling entry: matching_entries pools a
+               # sharded capture only with its own mesh shape
+               **({"mesh_config": obs_history.mesh_config_str(mesh_shape)}
+                  if mesh_shape else {})},
     )
     if args.gate:
         # gate BEFORE appending: the baseline is the ledger as it stood,

@@ -303,6 +303,19 @@ def _reshard_sites(spec: GraphSpec) -> list[Finding]:
     return out
 
 
+def reshard_sites(spec: GraphSpec) -> list[Finding]:
+    """Public face of the reshard-pairing proof, for the executor.
+
+    The sharded execution layer refuses to run a graph with reshard-site
+    violations: the executor derives each node's paired in/out shardings
+    from the declared edges (parallel/mesh.py ``node_sharding_plan``), and
+    that pairing is only a *plan* — not a proof — if some node's declared
+    inputs and outputs disagree. Same findings ``analyze`` reports; this
+    entry point skips the liveness walk so the runtime gate stays cheap.
+    """
+    return _reshard_sites(spec)
+
+
 def analyze(spec: GraphSpec, byte_model: dict[str, int] | None = None,
             ) -> Report:
     """Run every semantic analysis over one built graph."""

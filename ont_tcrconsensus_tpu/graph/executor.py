@@ -116,6 +116,16 @@ class GraphExecutor:
         # and safely withdraws its donation.
         self._donation_plan = graph_check.donation_plan(spec)
 
+        # sharded execution (ROADMAP-2): when the context's engine carries
+        # a device mesh, the declared Edge.sharding specs become the
+        # executable plan — paired in/out shardings per node, derived once
+        # here and published per node as ``ctx.node_shardings``.  The
+        # reshard-pairing proof is a hard gate: a graph whose declared
+        # shardings disagree across any node would make the "stage
+        # boundaries never reshard" discipline a lie, so the executor
+        # refuses it outright instead of letting XLA insert the shuffle.
+        self._shard_plan = self._mesh_setup()
+
         skip, resume_node = self._resume_scan()
         values = dict(inputs)
         refs: dict[str, int] = {}
@@ -150,7 +160,8 @@ class GraphExecutor:
                 self._commit_pending(values, refs)
             audit = self._donation_probe(node, values, refs)
             self._set_donate_edges(node)
-            outputs = self._run_node(node, node_inputs, units)
+            self._set_node_shardings(node)
+            outputs = self._run_node_degradable(node, node_inputs, units)
             if audit:
                 out_probe = obs_transfers.buffer_probe(outputs)
                 for e, probe in audit.items():
@@ -216,6 +227,75 @@ class GraphExecutor:
                 node.name, frozenset())
         except Exception:
             pass
+
+    def _mesh_setup(self):
+        """The per-node sharding plan when the run is mesh-armed, else
+        ``None``.  jax stays un-imported on unsharded runs: the lazy
+        import only happens once a mesh actually exists on the engine."""
+        mesh = getattr(getattr(self.ctx, "engine", None), "mesh", None)
+        if mesh is None:
+            return None
+        bad = graph_check.reshard_sites(self.spec)
+        if bad:
+            raise RuntimeError(
+                f"graph {self.spec.name!r} cannot run sharded: "
+                + "; ".join(f.format() for f in bad)
+            )
+        from ont_tcrconsensus_tpu.parallel import mesh as mesh_mod
+
+        return mesh_mod.node_sharding_plan(self.spec, mesh)
+
+    def _set_node_shardings(self, node: Node) -> None:
+        """Publish the node's paired in/out sharding axes on the context
+        (``ctx.node_shardings``) before its body runs — the pjit
+        discipline's runtime face: producers place outputs with exactly
+        the consumer's declared in-spec, so stage boundaries never
+        reshard. Best effort, like :meth:`_set_donate_edges`."""
+        if self._shard_plan is None:
+            return
+        try:
+            self.ctx.node_shardings = self._shard_plan.get(node.name)
+        except Exception:
+            pass
+
+    def _run_node_degradable(self, node: Node, inputs: dict,
+                             units: int) -> dict:
+        """:meth:`_run_node` plus the degraded-mesh survival loop.
+
+        A ``device_lost`` escaping a node body means a mesh slice died
+        mid-dispatch: no same-mesh retry can succeed.  When the context
+        offers a ``remesh`` hook (pipeline/run.py installs one on sharded
+        runs), the executor shrinks the world instead of dying — the hook
+        re-meshes the engines onto the survivors, rescales the HBM budget
+        and batch quantization, and this loop re-runs the WHOLE node on
+        the degraded mesh (node bodies are pure up to their ``commit``,
+        which only runs on success, so the re-run is safe).  Each
+        degradation is recorded as a ``mesh.degraded`` event in the
+        robustness report and counted in telemetry; when the data axis
+        cannot shrink further, the fault propagates and the run dies
+        honestly.
+        """
+        while True:
+            try:
+                return self._run_node(node, inputs, units)
+            except Exception as exc:
+                if retry.classify(exc) != "device_lost":
+                    raise
+                remesh = getattr(self.ctx, "remesh", None)
+                detail = remesh(node.name, exc) if remesh is not None else None
+                if detail is None:
+                    raise
+                rec = retry.recorder()
+                rec.record("mesh.degraded", classification="device_lost",
+                           outcome="degraded", error=repr(exc),
+                           detail={"node": node.name, **detail})
+                obs_metrics.counter_add("mesh.degraded")
+                obs_metrics.mesh_degraded_add("mesh.device_lost")
+                self._set_node_shardings(node)
+                _log(f"WARNING: mesh slice lost in node {node.name!r} "
+                     f"({exc!r}); re-dispatching on degraded mesh "
+                     f"data={detail.get('data_from')}→"
+                     f"{detail.get('data_to')}")
 
     def _run_node(self, node: Node, inputs: dict, units: int) -> dict:
         ctx = self.ctx

@@ -49,15 +49,26 @@ class LibraryContext:
     budget: Any = None
     failed_groups: list = dataclasses.field(default_factory=list)
     failed_regions: list = dataclasses.field(default_factory=list)
+    # sharded-execution hooks (filled by run.py on mesh-armed runs): the
+    # executor publishes each node's paired in/out sharding axes here
+    # before the body runs, and calls ``remesh(node, exc)`` when a
+    # device_lost escapes a node — the hook shrinks both engines' mesh to
+    # the survivors, rescales the HBM budget, and returns the degradation
+    # detail (or None when the data axis is already 1)
+    node_shardings: Any = None
+    remesh: Any = None
 
 
 def build_library_graph(cfg: RunConfig) -> GraphSpec:
     b = GraphBuilder("library")
     b.input("library_fastq", "disk")
     # Both device stores are batch-sharded over the mesh's data axis
-    # (ROADMAP item 2 groundwork): the spec is declarative for now — the
-    # executor ignores it, graftcheck pairs producer/consumer specs and
-    # would flag any node whose hbm inputs and outputs disagree.
+    # (ROADMAP item 2): on mesh-armed runs the executor compiles these
+    # declarations into the per-node sharding plan it publishes as
+    # ``ctx.node_shardings`` — producer out specs equal consumer in specs
+    # by construction, so stage boundaries never reshard; graftcheck's
+    # reshard-site lint is the hard gate (the executor refuses a graph
+    # whose declared shardings disagree across any node).
     b.edge("read_store", "hbm", sharding="data")
     # meta host edges carry orchestration values (stats, groupings,
     # selections) whose host residency is by design: graftcheck's

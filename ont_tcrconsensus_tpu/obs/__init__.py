@@ -113,6 +113,13 @@ OBS_SITES = frozenset({
     "transfer.d2h",
     "donation.audit",
     "memory.reconcile",
+    # --- sharded execution (parallel/mesh.py mark_mesh_slices: whole-mesh
+    # busy gauge via metrics.gauge_set; graph/executor.py degraded-mesh
+    # loop: re-execution counter via metrics.counter_add — the per-slice
+    # and per-fault-site label tables ride their own families,
+    # tcr_mesh_slice_busy / tcr_mesh_degraded_total) ---
+    "mesh.slice_busy",
+    "mesh.degraded",
 })
 
 KNOWN_SITES = OBS_SITES

@@ -105,6 +105,16 @@ def git_sha(cwd: str | None = None) -> str | None:
     return proc.stdout.strip() or None
 
 
+def mesh_config_str(mesh_shape) -> str | None:
+    """Canonical ledger spelling of a mesh shape: ``"data=4"`` /
+    ``"data=4,model=2"`` (axis order as declared); None for no mesh.
+    A plain string so ledger entries compare by equality in
+    :func:`matching_entries` without dict-ordering worries."""
+    if not mesh_shape:
+        return None
+    return ",".join(f"{k}={v}" for k, v in dict(mesh_shape).items())
+
+
 def detect_backend() -> str | None:
     """The active jax backend WITHOUT importing jax: reads the module only
     when the calling process already loaded it (run/bench paths), so the
@@ -300,9 +310,15 @@ class GateResult:
 
 def matching_entries(entries: list[dict], current: dict) -> list[dict]:
     """Baseline pool: entries agreeing with ``current`` on fingerprint,
-    backend and n_reads (``current`` itself excluded by identity, so
-    gating the ledger's own latest entry works)."""
-    keys = ("fingerprint", "backend", "n_reads")
+    backend, n_reads and mesh_config (``current`` itself excluded by
+    identity, so gating the ledger's own latest entry works).
+
+    ``mesh_config`` compares via ``.get()`` on both sides: legacy entries
+    (written before sharded execution) and single-device runs both lack
+    the key, so they pool together — a ``--mesh data=N`` arm's throughput
+    only ever gates against the same mesh shape, never against the
+    single-device baseline it is allowed to beat or trail."""
+    keys = ("fingerprint", "backend", "n_reads", "mesh_config")
     return [e for e in entries
             if e is not current
             and all(e.get(k) == current.get(k) for k in keys)]
@@ -578,10 +594,16 @@ def record_run(nano_dir: str, cfg, *, suffix: str = "") -> dict | None:
         reg = metrics.registry()
         if reg is None:
             return None
+        mesh_shape = getattr(cfg, "mesh_shape", None)
         entry = build_entry(
             "run", reg.summary(),
             fingerprint=config_fingerprint(cfg),
             sha=git_sha(), backend=detect_backend(),
+            # per-mesh-config scaling entries: "data=2,model=2" — absent
+            # (not null) on single-device runs so they pool with legacy
+            # baselines in matching_entries
+            extra=({"mesh_config": mesh_config_str(mesh_shape)}
+                   if mesh_shape else None),
         )
         name = f"history{suffix}.jsonl" if suffix else HISTORY_BASENAME
         append_entry(os.path.join(nano_dir, name), entry)

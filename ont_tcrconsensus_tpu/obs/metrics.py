@@ -46,6 +46,14 @@ class MetricsRegistry:
         # invalid_config / draining / body_too_large); its own table
         # because the reason is a label dimension, not an OBS_SITES site
         self.serve_rejects: dict[str, float] = {}
+        # mesh slice ("cpu:0") -> busy fraction (1.0 carrying work, 0.0
+        # lost/idle); last-value semantics like gauges_live — a /metrics
+        # scrape after a degradation must show the dead slice at 0. Its
+        # own table because the slice is a label dimension, not a site.
+        self.mesh_slices: dict[str, float] = {}
+        # chaos/fault site ("mesh.device_lost") -> count of degraded-mesh
+        # re-executions it caused; label dimension, not an OBS_SITES site
+        self.mesh_degraded: dict[str, float] = {}
         # site -> [count, sum, min, max]
         self.hists: dict[str, list[float]] = {}
         # name -> [seconds, calls]
@@ -114,6 +122,14 @@ class MetricsRegistry:
     def reject_add(self, reason: str, n: float = 1) -> None:
         with self._lock:
             self.serve_rejects[reason] = self.serve_rejects.get(reason, 0) + n
+
+    def mesh_slice_set(self, slice_id: str, busy: float) -> None:
+        with self._lock:
+            self.mesh_slices[slice_id] = busy
+
+    def mesh_degraded_add(self, site: str, n: float = 1) -> None:
+        with self._lock:
+            self.mesh_degraded[site] = self.mesh_degraded.get(site, 0) + n
 
     def observe(self, site: str, value: float) -> None:
         with self._lock:
@@ -285,6 +301,14 @@ class MetricsRegistry:
                         k: int(self.serve_rejects[k])
                         for k in sorted(self.serve_rejects)}}
                    if self.serve_rejects else {}),
+                **({"mesh_slice_busy": {
+                        k: self.mesh_slices[k]
+                        for k in sorted(self.mesh_slices)}}
+                   if self.mesh_slices else {}),
+                **({"mesh_degraded_by_site": {
+                        k: int(self.mesh_degraded[k])
+                        for k in sorted(self.mesh_degraded)}}
+                   if self.mesh_degraded else {}),
                 "histograms": {
                     k: {"count": int(v[0]), "sum": round(v[1], 3),
                         "min": v[2], "max": v[3]}
@@ -415,6 +439,16 @@ class MetricsRegistry:
                 "over_budget / invalid_config / draining / body_too_large).",
                 [("reason", k, self.serve_rejects[k])
                  for k in sorted(self.serve_rejects)])
+            fam(lines, "tcr_mesh_slice_busy", "gauge",
+                "Per-mesh-slice busy fraction (1 carrying work, 0 "
+                "lost/idle after a degradation).",
+                [("slice", k, self.mesh_slices[k])
+                 for k in sorted(self.mesh_slices)])
+            fam(lines, "tcr_mesh_degraded_total", "counter",
+                "Degraded-mesh re-executions by the fault site that "
+                "caused them.",
+                [("site", k, self.mesh_degraded[k])
+                 for k in sorted(self.mesh_degraded)])
             for i, (suffix, help_) in enumerate((
                 ("count", "Histogram observation counts."),
                 ("sum", "Histogram observation sums."),
@@ -536,6 +570,8 @@ LOCK_OWNERSHIP = {
     "MetricsRegistry.gauges": "_lock",
     "MetricsRegistry.gauges_live": "_lock",
     "MetricsRegistry.serve_rejects": "_lock",
+    "MetricsRegistry.mesh_slices": "_lock",
+    "MetricsRegistry.mesh_degraded": "_lock",
     "MetricsRegistry.hists": "_lock",
     "MetricsRegistry.stages": "_lock",
     "MetricsRegistry.dispatch": "_lock",
@@ -614,6 +650,26 @@ def reject_add(reason: str, n: float = 1) -> None:
     reg = _ARMED
     if reg is not None:
         reg.reject_add(reason, n)
+
+
+def mesh_slice_set(slice_id: str, busy: float) -> None:
+    """Record a mesh slice's busy fraction (``tcr_mesh_slice_busy``);
+    free no-op when telemetry is off. The argument is a label value
+    (device id), not an OBS_SITES site — the mesh.slice_busy gauge is
+    planted separately (parallel/mesh.py ``mark_mesh_slices``)."""
+    reg = _ARMED
+    if reg is not None:
+        reg.mesh_slice_set(slice_id, busy)
+
+
+def mesh_degraded_add(site: str, n: float = 1) -> None:
+    """Count a degraded-mesh re-execution under the fault site that
+    caused it (``tcr_mesh_degraded_total``); free no-op when telemetry
+    is off. The argument is a label value, not an OBS_SITES site — the
+    mesh.degraded counter is planted separately (graph/executor.py)."""
+    reg = _ARMED
+    if reg is not None:
+        reg.mesh_degraded_add(site, n)
 
 
 def graph_node_add(name: str, *, critical_s: float = 0.0,

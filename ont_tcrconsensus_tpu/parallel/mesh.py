@@ -27,7 +27,9 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
 from ont_tcrconsensus_tpu.obs import transfers as obs_transfers
+from ont_tcrconsensus_tpu.robustness import faults
 
 
 def make_mesh(shape: dict[str, int] | None = None, devices=None) -> Mesh:
@@ -86,18 +88,127 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def materialized_shard_bytes(placed) -> int:
+    """Bytes the device(s) actually hold for one placed array: the sum
+    over its addressable shards. For a data-sharded array this equals the
+    logical nbytes (each row lives on exactly one slice); for a
+    REPLICATED placement it is N copies — the honest h2d charge either
+    way. Falls back to the logical size when the shard API is absent
+    (plain numpy input, old jax)."""
+    try:
+        shards = placed.addressable_shards
+        total = 0
+        for s in shards:
+            total += int(s.data.nbytes)
+        return total
+    except Exception:
+        return obs_transfers.nbytes_of(placed)
+
+
+def mark_mesh_slices(mesh: Mesh, busy: float = 1.0) -> None:
+    """Per-slice busy gauge (``tcr_mesh_slice_busy``): every device of the
+    active mesh marked ``busy``; :func:`degrade_mesh` re-marks survivors 1
+    and the lost slice 0, so a /metrics scrape shows exactly which slices
+    still carry work. Free no-op when telemetry is off."""
+    if not obs_metrics.armed():
+        return
+    for d in mesh.devices.flat:
+        obs_metrics.mesh_slice_set(f"{d.platform}:{d.id}", busy)
+    obs_metrics.gauge_set("mesh.slice_busy", float(mesh.devices.size) * busy)
+
+
 def shard_batch(mesh: Mesh, *arrays):
     """device_put each array with its leading axis on the data axis.
 
     Leading dimensions must divide the data-axis size; callers pad batches
     (the pipeline's static-shape batching already guarantees this for
     power-of-two batch sizes).
+
+    The transfer ledger is charged PER MATERIALIZED SHARD (summed
+    ``addressable_shards`` bytes), not once per logical array: under
+    ``data=N`` the device-side bytes are what ``--report --memory``
+    reconciles against, and a replicated placement really does move N
+    copies over the interconnect.
     """
+    faults.inject("mesh.dispatch")
     out = []
+    nbytes = 0
     for a in arrays:
-        out.append(jax.device_put(a, data_sharding(mesh, np.ndim(a))))
-    obs_transfers.h2d("transfer.h2d", arrays)
+        placed = jax.device_put(a, data_sharding(mesh, np.ndim(a)))
+        nbytes += materialized_shard_bytes(placed)
+        out.append(placed)
+    obs_transfers.h2d("transfer.h2d", None, nbytes=nbytes)
+    mark_mesh_slices(mesh)
     return tuple(out) if len(out) > 1 else out[0]
+
+
+def degrade_mesh(mesh: Mesh) -> Mesh | None:
+    """The surviving mesh after one data slice is lost, or ``None`` when
+    the data axis cannot shrink (already 1 — nothing left to degrade to;
+    the caller re-raises and the run dies honestly).
+
+    The new data axis is the largest power of two <= (n_data - 1), over
+    the FIRST surviving devices of the old mesh: power-of-two keeps the
+    pipeline's batch-divisibility discipline (pad-to-multiple batching,
+    pow2 compile-shape buckets) intact through the degradation, so the
+    re-dispatched node runs the exact single-chip program per slice —
+    just fewer slices. Non-data axes are preserved.
+    """
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    n_data = axes.get("data", 1)
+    if n_data <= 1:
+        return None
+    new_n = 1
+    while new_n * 2 <= n_data - 1:
+        new_n *= 2
+    axes["data"] = new_n
+    names = tuple(axes)
+    sizes = tuple(axes[n] for n in names)
+    survivors = list(mesh.devices.flat)[: int(np.prod(sizes))]
+    lost = [d for d in mesh.devices.flat if d not in survivors]
+    new_mesh = Mesh(np.array(survivors).reshape(sizes), names)
+    if obs_metrics.armed():
+        for d in lost:
+            obs_metrics.mesh_slice_set(f"{d.platform}:{d.id}", 0.0)
+    mark_mesh_slices(new_mesh)
+    return new_mesh
+
+
+def node_sharding_plan(spec, mesh: Mesh) -> dict[str, dict]:
+    """Per-node paired in/out shardings from the graph's declared
+    :attr:`Edge.sharding` specs — the pjit discipline made executable.
+
+    For every node, each hbm edge with a declared sharding maps to a
+    :class:`NamedSharding` whose leading axis is the declared mesh axis
+    (the batch axis; trailing dims replicated — ndim is resolved at
+    placement time via :func:`data_sharding`, the plan stores the leading
+    axis name). Producer out specs equal consumer in specs BY
+    CONSTRUCTION of the graph (graftcheck's reshard-site lint is a hard
+    violation), so stage boundaries never reshard. Returns
+    ``{node: {"in": {edge: axis}, "out": {edge: axis}}}`` for nodes
+    touching at least one declared edge.
+    """
+    plan: dict[str, dict] = {}
+    for node in spec.schedule:
+        ins = {
+            e: spec.edges[e].sharding for e in node.inputs
+            if e in spec.edges and spec.edges[e].placement == "hbm"
+            and spec.edges[e].sharding is not None
+        }
+        outs = {
+            e: spec.edges[e].sharding for e in node.outputs
+            if e in spec.edges and spec.edges[e].placement == "hbm"
+            and spec.edges[e].sharding is not None
+        }
+        if ins or outs:
+            plan[node.name] = {"in": ins, "out": outs}
+    return plan
+
+
+def axis_sharding(mesh: Mesh, axis: str, ndim: int) -> NamedSharding:
+    """NamedSharding splitting the leading dim over ``axis`` (the runtime
+    face of one :func:`node_sharding_plan` entry)."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
 
 
 def polisher_param_sharding(mesh: Mesh, params) -> dict:
@@ -131,17 +242,25 @@ def sharded_train_step(mesh: Mesh, optimizer):
     base_step = polisher_mod.make_train_step(optimizer)
 
     def place_params(params):
+        # replicated params materialize one copy PER device: the shard sum
+        # is the honest h2d charge, not the logical tree size
         placed = jax.device_put(params, polisher_param_sharding(mesh, params))
-        obs_transfers.h2d("transfer.h2d", jax.tree_util.tree_leaves(params))
+        obs_transfers.h2d("transfer.h2d", None, nbytes=sum(
+            materialized_shard_bytes(leaf)
+            for leaf in jax.tree_util.tree_leaves(placed)
+        ))
         return placed
 
     def place_batch(feats, labels, ins_labels, mask):
-        obs_transfers.h2d("transfer.h2d", (feats, labels, ins_labels, mask))
-        return (
+        placed = (
             jax.device_put(feats, data_sharding(mesh, 3)),
             jax.device_put(labels, data_sharding(mesh, 2)),
             jax.device_put(ins_labels, data_sharding(mesh, 2)),
             jax.device_put(mask, data_sharding(mesh, 2)),
         )
+        obs_transfers.h2d("transfer.h2d", None, nbytes=sum(
+            materialized_shard_bytes(p) for p in placed
+        ))
+        return placed
 
     return jax.jit(base_step), place_params, place_batch

@@ -675,6 +675,14 @@ class AssignEngine:
         self.primer_shapes = tuple(len(p) for p in primers)
         self._sharded_cache: dict[bool, object] = {}
 
+    def set_mesh(self, mesh) -> None:
+        """Swap the engine onto a different mesh mid-run (the degraded-mesh
+        re-execution path): every cached shard_map program was compiled
+        against the OLD mesh's device set, so the cache is dropped — the
+        next dispatch recompiles against the survivors."""
+        self.mesh = mesh
+        self._sharded_cache.clear()
+
     def _static_kwargs(self, has_quals: bool, fast: bool) -> dict:
         return dict(
             top_k=self.top_k, band_width=self.band_width,
@@ -748,6 +756,7 @@ class AssignEngine:
             jnp.float32(overlap_frac if overlap_frac is not None else 0.0),
         )
         if self.mesh is not None:
+            robustness_faults.inject("mesh.dispatch")
             return self._sharded_fn(has_quals, fast)(*args)
         return _fused_pass(*args, **self._static_kwargs(has_quals, fast))
 
@@ -796,6 +805,7 @@ class AssignEngine:
             jnp.int32(min_len),
         )
         if self.mesh is not None:
+            robustness_faults.inject("mesh.dispatch")
             return self._sharded_targeted_fn(max_c)(*args)
         return _targeted_pass(
             *args, band_width=self.band_width, a5=self.a5, a3=self.a3,

@@ -632,6 +632,46 @@ def _run_library(fastq, lay, cfg, panel, engine, engine_notrim,
             qc_exec.record_pool_metrics()
 
 
+def _make_remesh(ctx):
+    """The degraded-mesh hook the graph executor calls when a
+    ``device_lost`` escapes a node body (graph/executor.py
+    ``_run_node_degradable``).
+
+    Shrinks the world to the surviving slices and returns the degradation
+    detail, or None when the data axis is already 1 (nothing left to
+    degrade to — the executor re-raises and the run dies honestly):
+
+    - both engines re-mesh onto the survivors (``AssignEngine.set_mesh``
+      drops every shard_map program compiled against the dead device set);
+    - the HBM budget rescales by the survival fraction
+      (parallel/budget.py ``degraded_budget``) so every batch derived
+      after the loss keeps the per-slice load constant;
+    - ``read_batch`` re-quantizes to the new data-axis size, preserving
+      the pad-to-multiple discipline for the re-dispatched node.
+    """
+    from ont_tcrconsensus_tpu.parallel import budget as budget_mod
+    from ont_tcrconsensus_tpu.parallel import mesh as mesh_mod
+
+    def _remesh(node_name, exc):
+        old = ctx.engine.mesh
+        degraded = mesh_mod.degrade_mesh(old)
+        if degraded is None:
+            return None
+        old_n = mesh_mod.mesh_data_size(old)
+        new_n = mesh_mod.mesh_data_size(degraded)
+        for eng in (ctx.engine, ctx.engine_notrim):
+            if eng is not None and getattr(eng, "mesh", None) is not None:
+                eng.set_mesh(degraded)
+        if ctx.budget is not None:
+            ctx.budget = budget_mod.degraded_budget(ctx.budget, new_n, old_n)
+        if ctx.read_batch:
+            rb = ctx.read_batch
+            ctx.read_batch = max(rb - rb % new_n, new_n)
+        return {"data_from": old_n, "data_to": new_n}
+
+    return _remesh
+
+
 def _run_library_graph(fastq, lay, cfg, panel, engine, engine_notrim,
                        blast_id_threshold, overlap_consensus, polisher,
                        read_batch, budget, qc_exec) -> dict[str, int]:
@@ -651,6 +691,8 @@ def _run_library_graph(fastq, lay, cfg, panel, engine, engine_notrim,
         overlap_consensus=overlap_consensus, polisher=polisher,
         read_batch=read_batch, budget=budget,
     )
+    if engine is not None and getattr(engine, "mesh", None) is not None:
+        ctx.remesh = _make_remesh(ctx)
     spec = graph_pipeline.build_library_graph(cfg)
     try:
         # Static graftcheck verdict rides telemetry.json / the history

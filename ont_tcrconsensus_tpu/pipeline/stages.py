@@ -837,6 +837,13 @@ def _polish_bucket_loop(prepared, by_group, failed, packer, *, rounds,
                         # progressing, never from many fast chunks
                         watchdog.heartbeat("polish.chunk")
                         faults.inject("polish.dispatch")
+                        if mesh is not None:
+                            # mesh-only faults: a slice dying mid-node
+                            # (escalates to the executor's degraded-mesh
+                            # re-execution) and a per-slice OOM (rides the
+                            # ordinary shrink-and-requeue path below)
+                            faults.inject("mesh.device_lost")
+                            faults.inject("mesh.slice_oom")
                         # double-buffered pack: chunk N's tile was stacked
                         # by the background worker while chunk N-1 ran on
                         # device (futures cache their result, so a retry
@@ -862,6 +869,15 @@ def _polish_bucket_loop(prepared, by_group, failed, packer, *, rounds,
                     except Exception as exc:
                         pol, rec = retry.policy(), retry.recorder()
                         cls = retry.classify(exc)
+                        if cls == "device_lost":
+                            # a dead slice can't be retried OR shrunk
+                            # around from here: escalate to the graph
+                            # executor, which shrinks the mesh's data axis
+                            # to the survivors and re-runs the whole node
+                            rec.record("polish.dispatch", classification=cls,
+                                       outcome="escalated", attempt=attempt,
+                                       error=repr(exc))
+                            raise
                         if cls == "transient" and attempt < pol.max_attempts:
                             rec.record("polish.dispatch", classification=cls,
                                        outcome="retried", attempt=attempt,

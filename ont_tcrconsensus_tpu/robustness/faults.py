@@ -43,6 +43,21 @@ site                     planted at
 ``serve.prewarm``        the AOT bucket prewarm (serve/daemon.py) — a
                          failed prewarm must degrade to a report line,
                          never a dead daemon
+``mesh.dispatch``        the sharded placement/dispatch boundary: batch
+                         shard placement (parallel/mesh.py, shard_batch)
+                         and the engine's shard_map dispatch
+                         (pipeline/assign.py) — a ``transient`` here
+                         rides the existing bounded-retry ladder
+``mesh.device_lost``     the sharded polish chunk dispatch
+                         (pipeline/stages.py, mesh armed only) —
+                         ``device-lost`` raises
+                         :class:`DeviceLostChaosError`, which escalates
+                         past the chunk ladder to the graph executor's
+                         degraded-mesh re-execution path
+``mesh.slice_oom``       same boundary — an ``oom`` on one slice of the
+                         mesh rides the existing shrink-and-requeue
+                         ladder (the per-chip allowance is the binding
+                         one under sharding)
 ======================== ====================================================
 
 Fault kinds:
@@ -51,6 +66,10 @@ Fault kinds:
   retryable device/transport fault, message carries ``UNAVAILABLE``)
 - ``oom``       — raises :class:`OomChaosError` (classified as HBM
   exhaustion, message carries ``RESOURCE_EXHAUSTED``)
+- ``device-lost`` — raises :class:`DeviceLostChaosError` (a mesh slice
+  died mid-dispatch, message carries ``DEVICE_LOST``; retrying the same
+  mesh cannot succeed — the executor shrinks the data axis to the
+  surviving slices and re-dispatches)
 - ``error``     — raises a plain ``RuntimeError`` (a deterministic bug:
   never retried, exercises the skip/degrade paths)
 - ``kill``      — ``os._exit(137)``: unflushable process death, exactly
@@ -100,8 +119,8 @@ ENV_VAR = "TCR_CHAOS"
 #: RESUME-integrity fault: it flips a byte of a completed stage's artifact
 #: in place (size-preserving, so only ``verify_resume=full`` checksums can
 #: catch it) through :func:`corrupt_artifact` at ``resume.verify``.
-KINDS = ("transient", "oom", "error", "kill", "preempt", "torn",
-         "corrupt-input", "truncate-file", "stall", "hang",
+KINDS = ("transient", "oom", "device-lost", "error", "kill", "preempt",
+         "torn", "corrupt-input", "truncate-file", "stall", "hang",
          "corrupt-artifact")
 
 #: every injection point planted in the pipeline; arming an unknown site is
@@ -122,6 +141,9 @@ KNOWN_SITES = frozenset({
     "serve.daemon_loop",
     "serve.journal_write",
     "serve.prewarm",
+    "mesh.dispatch",
+    "mesh.device_lost",
+    "mesh.slice_oom",
 })
 
 KILL_EXIT_CODE = 137
@@ -133,6 +155,12 @@ class TransientChaosError(RuntimeError):
 
 class OomChaosError(RuntimeError):
     """Injected HBM exhaustion (degradable: shrink the batch and retry)."""
+
+
+class DeviceLostChaosError(RuntimeError):
+    """Injected mesh-slice loss (degradable: shrink the data axis to the
+    surviving slices and re-dispatch — retrying the dead mesh cannot
+    succeed, and no smaller batch fits a device that is gone)."""
 
 
 @dataclasses.dataclass
@@ -268,6 +296,8 @@ def _fire(spec: FaultSpec, site: str) -> None:
         raise TransientChaosError(f"UNAVAILABLE: {msg}")
     if spec.kind == "oom":
         raise OomChaosError(f"RESOURCE_EXHAUSTED: {msg}")
+    if spec.kind == "device-lost":
+        raise DeviceLostChaosError(f"DEVICE_LOST: {msg}")
     if spec.kind == "error":
         raise RuntimeError(msg)
     if spec.kind == "kill":

@@ -10,6 +10,12 @@ sites, and they want three different answers:
 - **oom** (``RESOURCE_EXHAUSTED``, HBM exhaustion): retrying the same
   shape fails forever; the caller must shrink the batch (re-enter
   parallel/budget.py with a smaller budget) and retry the smaller shape.
+- **device_lost** (``DEVICE_LOST`` — a mesh slice died mid-dispatch):
+  neither retrying the same mesh nor shrinking the batch can succeed —
+  the fault escalates to the graph executor, which shrinks the data
+  axis to the surviving slices, recomputes the HBM allowance, and
+  re-dispatches the node on the degraded mesh (recorded as a
+  ``mesh.degraded`` event).
 - **fatal** (everything else — a deterministic bug): never retry; fall
   through to the existing skip-and-report degradation immediately.
 
@@ -44,6 +50,18 @@ OOM_MARKERS = (
     "HBM",
 )
 
+#: substrings marking an exception as the loss of a mesh slice/device.
+#: Checked BEFORE both other marker sets: a dead device's message may also
+#: mention the allocator or the transport, but the device being gone is
+#: the binding fact — neither a same-shape retry nor a smaller batch can
+#: ever land on it again.
+DEVICE_LOST_MARKERS = (
+    "DEVICE_LOST",
+    "device_lost",
+    "Device lost",
+    "device halted",
+)
+
 #: substrings marking an exception as a retryable device/transport fault
 TRANSIENT_MARKERS = (
     "UNAVAILABLE",
@@ -61,9 +79,12 @@ TRANSIENT_MARKERS = (
 
 
 def classify(exc: BaseException) -> str:
-    """``"transient" | "oom" | "fatal"`` for an exception from a dispatch
-    site. Unknown exceptions are fatal: retrying a deterministic bug only
-    burns the retry budget and delays the skip-and-report degradation."""
+    """``"transient" | "oom" | "device_lost" | "fatal"`` for an exception
+    from a dispatch site. Unknown exceptions are fatal: retrying a
+    deterministic bug only burns the retry budget and delays the
+    skip-and-report degradation."""
+    if isinstance(exc, faults.DeviceLostChaosError):
+        return "device_lost"
     if isinstance(exc, faults.OomChaosError) or isinstance(exc, MemoryError):
         return "oom"
     if isinstance(exc, faults.TransientChaosError):
@@ -76,6 +97,8 @@ def classify(exc: BaseException) -> str:
     if isinstance(exc, (ConnectionError, TimeoutError, BrokenPipeError)):
         return "transient"
     msg = f"{type(exc).__name__}: {exc}"
+    if any(m in msg for m in DEVICE_LOST_MARKERS):
+        return "device_lost"
     if any(m in msg for m in OOM_MARKERS):
         return "oom"
     if any(m in msg for m in TRANSIENT_MARKERS):
@@ -235,6 +258,7 @@ def call_with_retry(site: str, fn, *, policy: RetryPolicy | None = None,
                 rec.record(site, classification=cls,
                            outcome=("fatal" if cls == "fatal"
                                     else "not_retryable" if cls == "oom"
+                                    else "escalated" if cls == "device_lost"
                                     else "exhausted"),
                            attempt=attempt, error=repr(exc))
                 raise

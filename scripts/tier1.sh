@@ -20,7 +20,10 @@
 # SIGUSR1 flushes the flight recorder, outputs byte-identical to a
 # live-off run), a graph-executor smoke (tiny workload
 # under executor=graph vs imperative — counts CSV + consensus FASTA
-# byte-identical, telemetry attributed per node), a perf-gate smoke (two
+# byte-identical, telemetry attributed per node), a sharded-mesh smoke
+# (data=2 run byte-identical to unsharded; slice lost mid-polish ->
+# degraded mesh -> still byte-identical; reshard hard gate), a perf-gate
+# smoke (two
 # tiny runs feed a shared run-history ledger; scripts/perf_gate.py stays
 # quiet on an identical replay and exits nonzero on a seeded +30%
 # regression; --report --critical-path explains the executed graph
@@ -155,6 +158,21 @@ grc=$?
 if [ "$grc" -ne 0 ]; then
     echo "graph executor smoke FAILED (rc=$grc)" >&2
     exit "$grc"
+fi
+
+echo "--- sharded-mesh smoke (data=2 run byte-identical to the unsharded"
+echo "    baseline; a slice lost mid-polish degrades the mesh and still"
+echo "    completes byte-identically with a recorded mesh.degraded event;"
+echo "    the executor refuses a graph whose declared shardings reshard) ---"
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_pipeline_e2e.py tests/test_chaos.py tests/test_graph.py -q \
+    -m "" \
+    -k "counts_match_ground_truth or mesh_data2_byte_identical or mesh_device_lost or mesh_refuses_resharding" \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+mrc=$?
+if [ "$mrc" -ne 0 ]; then
+    echo "sharded-mesh smoke FAILED (rc=$mrc)" >&2
+    exit "$mrc"
 fi
 
 echo "--- perf-gate smoke (two tiny runs feed a shared history ledger:"

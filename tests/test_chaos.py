@@ -234,6 +234,67 @@ def test_chaos_poisoned_batched_pass_falls_back_per_region(
     assert "counts" in _manifest_stages(root)
 
 
+def test_chaos_mesh_device_lost_degrades_and_completes(chaos_lib, tmp_path):
+    """A mesh slice dying mid-polish (DEVICE_LOST on the sharded chunk
+    dispatch) escalates to the graph executor, which shrinks the data
+    axis to the survivors (2 -> 1), rescales the HBM budget, re-runs the
+    node on the degraded mesh, and completes byte-identically — with the
+    degradation recorded as a mesh.degraded event and counted in
+    telemetry."""
+    root = tmp_path / "mesh_lost"
+    _stage_inputs(chaos_lib["inputs"], root)
+    results = run_with_config(_cfg(root, mesh_shape={"data": 2}, chaos=[
+        {"site": "mesh.device_lost", "kind": "device-lost"},
+    ]))
+    assert results["barcode01"] == chaos_lib["baseline_counts"]
+    assert faults.fired("mesh.device_lost") == 1
+    _assert_byte_identical(chaos_lib, root)
+    report = _report(root)
+    # the polish loop escalated instead of retrying the broken mesh...
+    escalated = report["sites"]["polish.dispatch"]["by_outcome"]
+    assert escalated["escalated"] == 1
+    assert "retried" not in escalated and "oom_shrink" not in escalated
+    # ...and the executor's degraded-mesh loop re-ran the node
+    degraded = report["sites"]["mesh.degraded"]["by_outcome"]
+    assert degraded["degraded"] == 1
+    ev = next(e for e in report["events"] if e["site"] == "mesh.degraded")
+    assert ev["classification"] == "device_lost"
+    assert ev["detail"]["node"] == "round1_polish"
+    assert ev["detail"]["data_from"] == 2 and ev["detail"]["data_to"] == 1
+    # telemetry: the re-execution is counted under the fault site, and the
+    # lost slice's busy gauge reads 0 with the survivor at 1
+    tele = json.loads(
+        (root / "fastq_pass" / "nano_tcr" / "telemetry.json").read_text())
+    assert tele["counters"]["mesh.degraded"] == 1
+    assert tele["mesh_degraded_by_site"] == {"mesh.device_lost": 1}
+    busy = tele["mesh_slice_busy"]
+    assert sorted(busy.values()) == [0.0, 1.0]
+    # no group was skipped: the degradation was a re-run, not a give-up
+    assert not (root / "fastq_pass" / "nano_tcr" / "barcode01" / "logs"
+                / "incomplete_region_clusters.log").exists()
+    assert "counts" in _manifest_stages(root)
+
+
+@pytest.mark.slow
+def test_chaos_mesh_slice_oom_shrinks_under_mesh(chaos_lib, tmp_path):
+    """HBM exhaustion on one slice of a sharded polish dispatch rides the
+    existing oom-shrink path (the batch requeues smaller, quantized to
+    the mesh), NOT the degraded-mesh escalation — the mesh keeps all its
+    slices and the run completes byte-identically."""
+    root = tmp_path / "mesh_oom"
+    _stage_inputs(chaos_lib["inputs"], root)
+    results = run_with_config(_cfg(root, mesh_shape={"data": 2}, chaos=[
+        {"site": "mesh.slice_oom", "kind": "oom"},
+    ]))
+    assert results["barcode01"] == chaos_lib["baseline_counts"]
+    assert faults.fired("mesh.slice_oom") == 1
+    _assert_byte_identical(chaos_lib, root)
+    report = _report(root)
+    outcomes = report["sites"]["polish.dispatch"]["by_outcome"]
+    assert outcomes["oom_shrink"] == 1
+    assert "mesh.degraded" not in report["sites"]
+
+
 # --- crash/resume scenarios -------------------------------------------------
 
 

@@ -347,6 +347,43 @@ def test_cli_expect_drift_fails(tmp_path, capsys, monkeypatch):
     assert N_REUP in err
 
 
+def _resharding_library_graph(cfg):
+    """A stand-in production graph where a declared "data" edge's consumer
+    re-emits under "model" — the exact boundary-reshard regression the
+    executor's hard gate and the --expect baseline both exist to catch."""
+    b = GraphBuilder("library")
+    b.input("src", "disk")
+    b.edge("ina", "hbm", sharding="data")
+    b.edge("outa", "hbm", sharding="model")
+    b.edge("res", "host")
+    b.add_node(N_UP, inputs=("src",), outputs=("ina",))
+    b.add_node(N_XFORM, inputs=("ina",), outputs=("outa",))
+    b.add_node(N_DOWN, inputs=("outa",), outputs=("res",))
+    b.result("res")
+    return b.build()
+
+
+def test_cli_expect_seeded_reshard_drift_fails(tmp_path, capsys, monkeypatch):
+    """ISSUE-18 permanence: reshard findings are hard under --expect. A
+    newly-resharding declared edge in the production graph is a NEW
+    violation vs the committed (empty) list and fails CI BY NAME — and
+    the same findings surface through the public reshard_sites() wrapper
+    the executor's sharded-run gate calls."""
+    bad = check.reshard_sites(_resharding_library_graph(_cfg()))
+    assert [f.kind for f in bad] == [K_RESHARD]
+    assert bad[0].subject == N_XFORM
+    assert bad[0].severity == "violation"
+    # the shipped production graph has ZERO reshard sites (the executor
+    # would refuse to run it sharded otherwise)
+    assert check.reshard_sites(graph_pipeline.build_library_graph(_cfg())) == []
+    monkeypatch.setattr(
+        graph_pipeline, "build_library_graph", _resharding_library_graph)
+    assert graftcheck_main(["--expect", DEFAULT_EXPECT]) == 1
+    err = capsys.readouterr().err
+    assert "NEW finding not in the expected list" in err
+    assert N_XFORM in err and K_RESHARD in err
+
+
 def test_cli_never_crashes_on_bad_inputs(tmp_path, capsys):
     assert graftcheck_main(["--config", str(tmp_path / "nope.json")]) == 2
     bad = tmp_path / "bad.json"

@@ -35,7 +35,7 @@ from ont_tcrconsensus_tpu.graph.executor import GraphExecutor
 from ont_tcrconsensus_tpu.graph.ir import GraphBuilder, GraphValidationError
 from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
 from ont_tcrconsensus_tpu.qc.timing import StageTimer
-from ont_tcrconsensus_tpu.robustness import faults
+from ont_tcrconsensus_tpu.robustness import faults, retry
 
 COUNTS_CSV = os.path.join("nano_tcr", "barcode01", "counts",
                           "umi_consensus_counts.csv")
@@ -351,6 +351,96 @@ def test_executor_chaos_site_fires_on_critical_node_bodies():
     with pytest.raises(faults.TransientChaosError):
         GraphExecutor(spec, _ctx()).run({"src": 3})
     assert faults.fired("graph.node") == 1
+
+
+def test_executor_mesh_refuses_resharding_graph():
+    """Under a mesh, a graph whose declared shardings disagree across a
+    node would make XLA reshard at a stage boundary: the executor refuses
+    it outright. Without a mesh the same graph runs — the gate (like the
+    whole sharding plan) is mesh-armed only."""
+    b = GraphBuilder("t")
+    b.input("src", "disk")
+    b.edge("ina", "hbm", sharding="data")
+    b.edge("outa", "hbm", sharding="model")
+    b.edge("res", "host")
+    b.add_node(N_LOAD, lambda ctx, i: {"ina": i["src"]},
+               inputs=("src",), outputs=("ina",))
+    b.add_node(N_COMPUTE, lambda ctx, i: {"outa": i["ina"]},
+               inputs=("ina",), outputs=("outa",))
+    b.add_node(N_FINISH, lambda ctx, i: {"res": i["outa"]},
+               inputs=("outa",), outputs=("res",))
+    b.result("res")
+    spec = b.build()
+    ctx = _ctx(engine=SimpleNamespace(mesh=object()))
+    with pytest.raises(RuntimeError, match="cannot run sharded"):
+        GraphExecutor(spec, ctx).run({"src": 1})
+    assert GraphExecutor(spec, _ctx()).run({"src": 5}) == {"res": 5}
+
+
+def test_executor_degraded_mesh_rerun_records_and_completes():
+    """A device_lost escaping a node body triggers the remesh hook, a
+    mesh.degraded record + telemetry counters, a republished sharding
+    plan, and a re-run of the WHOLE node — the run completes."""
+    b = GraphBuilder("t")
+    b.input("src", "disk")
+    b.edge("x", "hbm", sharding="data")
+    b.edge("out", "host")
+    calls = []
+
+    def body(ctx, i):
+        calls.append(ctx.node_shardings)
+        if len(calls) == 1:
+            raise faults.DeviceLostChaosError("DEVICE_LOST: slice 1 halted")
+        return {"x": i["src"] * 2}
+
+    b.add_node(N_LOAD, body, inputs=("src",), outputs=("x",))
+    b.add_node(N_COMPUTE, lambda ctx, i: {"out": i["x"] + 1},
+               inputs=("x",), outputs=("out",))
+    b.result("out")
+    spec = b.build()
+    remeshes = []
+
+    def remesh(node, exc):
+        remeshes.append(node)
+        return {"data_from": 2, "data_to": 1}
+
+    ctx = _ctx(engine=SimpleNamespace(mesh=object()), remesh=remesh)
+    rec = retry.recorder()
+    before = len(rec.events)
+    reg = obs_metrics.arm()
+    out = GraphExecutor(spec, ctx).run({"src": 3})
+    assert out == {"out": 7}
+    assert remeshes == [N_LOAD]
+    # both attempts saw the node's published plan (re-set after the remesh)
+    assert calls == [{"in": {}, "out": {"x": "data"}}] * 2
+    (ev,) = [e for e in rec.events[before:] if e["site"] == "mesh.degraded"]
+    assert ev["classification"] == "device_lost"
+    assert ev["outcome"] == "degraded"
+    assert ev["detail"] == {"node": N_LOAD, "data_from": 2, "data_to": 1}
+    s = reg.summary()
+    assert s["counters"]["mesh.degraded"] == 1
+    assert s["mesh_degraded_by_site"] == {"mesh.device_lost": 1}
+
+
+def test_executor_device_lost_without_remesh_propagates():
+    """No remesh hook (unsharded run) or a hook that cannot shrink any
+    further (returns None): the fault propagates and the run dies
+    honestly instead of looping."""
+    spec = _diamond().build()
+    calls = []
+
+    def dying(ctx, i):
+        calls.append(1)
+        raise faults.DeviceLostChaosError("DEVICE_LOST: no survivors")
+
+    spec.nodes[N_LOAD].fn = dying
+    with pytest.raises(faults.DeviceLostChaosError):
+        GraphExecutor(spec, _ctx()).run({"src": 3})
+    assert len(calls) == 1
+    ctx = _ctx(remesh=lambda node, exc: None)
+    with pytest.raises(faults.DeviceLostChaosError):
+        GraphExecutor(spec, ctx).run({"src": 3})
+    assert len(calls) == 2
 
 
 def test_executor_resume_skips_closure_and_reloads_crossing_edges():

@@ -222,6 +222,32 @@ def test_gate_baseline_pool_filters_on_fingerprint_backend_n_reads():
     assert len(pool) == 3 and all(e is not tail for e in pool)
 
 
+def test_gate_pools_per_mesh_config_with_legacy_tolerance():
+    """A --mesh data=N arm gates only against entries of the SAME mesh
+    shape; legacy entries (written before sharded execution, no
+    mesh_config key) pool with single-device runs — never with a mesh
+    arm, whose throughput is allowed to beat or trail single-device."""
+    assert history.mesh_config_str(None) is None
+    assert history.mesh_config_str({}) is None
+    assert history.mesh_config_str({"data": 8}) == "data=8"
+    assert history.mesh_config_str({"data": 4, "model": 2}) == "data=4,model=2"
+
+    legacy = [_entry(duration_s=1.0) for _ in range(3)]  # no mesh_config key
+    meshed = [_entry(duration_s=9.0, mesh_config="data=8") for _ in range(3)]
+    entries = legacy + meshed
+    # a mesh arm pools only with its own shape (9x slower than legacy: fine)
+    res = history.evaluate_gate(
+        entries, _entry(duration_s=9.0, mesh_config="data=8"))
+    assert res.status == "pass" and res.n_baseline == 3
+    assert res.baseline_median == 9.0
+    # a single-device run pools with the legacy entries, not the mesh arm
+    pool = history.matching_entries(entries, _entry(duration_s=1.0))
+    assert pool == legacy
+    # a different mesh shape is its own (empty) pool
+    assert history.matching_entries(
+        entries, _entry(mesh_config="data=4")) == []
+
+
 def test_gate_prefers_reads_per_sec_over_duration():
     entries = [_entry(reads_per_sec=100.0, duration_s=10.0)
                for _ in range(5)]

@@ -202,6 +202,9 @@ def test_metrics_text_is_valid_exposition_and_covers_registry():
         obs_metrics.pool_add("overlap.pool", busy_s=1.0, idle_s=0.5,
                              window_s=1.5, slots=2)
         obs_metrics.graph_node_add("round1_polish", critical_s=0.25)
+        obs_metrics.mesh_slice_set("cpu:0", 1.0)
+        obs_metrics.mesh_slice_set("cpu:1", 0.0)
+        obs_metrics.mesh_degraded_add("mesh.device_lost")
         text = obs_live._metrics_text()
     finally:
         obs_metrics.disarm()
@@ -213,7 +216,11 @@ def test_metrics_text_is_valid_exposition_and_covers_registry():
     assert fams["tcr_stage_seconds_total"] >= 1
     assert fams["tcr_pool_busy_seconds_total"] >= 1
     assert fams["tcr_graph_node_critical_seconds_total"] >= 1
+    assert fams["tcr_mesh_slice_busy"] == 2
+    assert fams["tcr_mesh_degraded_total"] == 1
     assert 'tcr_counter_total{site="assign.batches"} 3' in text
+    assert 'tcr_mesh_slice_busy{slice="cpu:1"} 0' in text
+    assert 'tcr_mesh_degraded_total{site="mesh.device_lost"} 1' in text
     # disarmed registry: still a valid, non-empty exposition
     fams_off = validate_prometheus(obs_live._metrics_text())
     assert fams_off == {"tcr_up": 1}

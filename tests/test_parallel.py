@@ -143,3 +143,116 @@ def test_graft_entry_multichip():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+# ---------------------------------------------------------------------------
+# per-materialized-shard transfer ledger + degraded-mesh machinery (ISSUE 18)
+
+
+@pytest.fixture
+def metrics_reg():
+    from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
+
+    reg = obs_metrics.arm()
+    yield reg
+    obs_metrics.disarm()
+
+
+def test_shard_batch_ledger_charges_per_shard_sum(metrics_reg):
+    """A data-sharded placement holds each row on exactly one slice: the
+    summed shard bytes — what the h2d ledger is charged — equal the
+    logical array size, for any mix of dtypes in one dispatch."""
+    m = mesh_mod.make_mesh({"data": 8})
+    x = np.zeros((16, 32), np.float32)
+    y = np.zeros((16,), np.int32)
+    sx, sy = mesh_mod.shard_batch(m, x, y)
+    assert mesh_mod.materialized_shard_bytes(sx) == x.nbytes
+    s = metrics_reg.summary()
+    tr = s["transfers"]["sites"]["transfer.h2d"]
+    assert tr["h2d_bytes"] == x.nbytes + y.nbytes
+    # every slice of the dispatching mesh is marked busy
+    assert s["mesh_slice_busy"] == {
+        f"{d.platform}:{d.id}": 1.0 for d in m.devices.flat
+    }
+    assert s["gauges"]["mesh.slice_busy"] == 8.0
+
+
+def test_replicated_placement_charges_n_copies(metrics_reg):
+    """A replicated placement really moves one copy per device; the
+    shard-sum charge is N x logical — the honest interconnect bill the
+    single-logical-size ledger used to hide."""
+    m = mesh_mod.make_mesh({"data": 8})
+    a = np.zeros((4, 4), np.float32)
+    placed = jax.device_put(a, mesh_mod.replicated(m))
+    assert (mesh_mod.materialized_shard_bytes(placed)
+            == m.devices.size * a.nbytes)
+    # plain numpy (no shard API): falls back to the logical size
+    assert mesh_mod.materialized_shard_bytes(a) == a.nbytes
+
+
+def test_degrade_mesh_pow2_ladder(metrics_reg):
+    """Losing a slice shrinks the data axis to the largest pow2 <= n-1
+    (8 -> 4 -> 2 -> 1 -> dead), keeping batch divisibility intact; the
+    lost slices' busy gauges drop to 0 and survivors re-mark 1."""
+    m = mesh_mod.make_mesh({"data": 8})
+    mesh_mod.mark_mesh_slices(m)
+    sizes = []
+    while m is not None:
+        m2 = mesh_mod.degrade_mesh(m)
+        if m2 is not None:
+            sizes.append(mesh_mod.mesh_data_size(m2))
+        m = m2
+    assert sizes == [4, 2, 1]
+    slices = metrics_reg.summary()["mesh_slice_busy"]
+    assert sum(v == 1.0 for v in slices.values()) == 1  # last survivor
+    assert sum(v == 0.0 for v in slices.values()) == 7
+
+
+def test_degrade_mesh_preserves_model_axis():
+    m = mesh_mod.make_mesh({"data": 4, "model": 2})
+    d = mesh_mod.degrade_mesh(m)
+    assert dict(zip(d.axis_names, d.devices.shape)) == {"data": 2, "model": 2}
+    # survivors are the FIRST devices of the old mesh, in order
+    assert list(d.devices.flat) == list(m.devices.flat)[:4]
+
+
+def test_degraded_budget_scales_hbm_proportionally():
+    from ont_tcrconsensus_tpu.parallel import budget as budget_mod
+
+    b = budget_mod.BudgetModel(hbm_gb=16.0)
+    d = budget_mod.degraded_budget(b, 1, 2)
+    assert d.hbm_gb == pytest.approx(8.0)
+    # every derived batch shrinks (or holds at the pow2 floor), never grows
+    assert d.read_batch(1024) <= b.read_batch(1024)
+    assert d.cluster_batch(8, 1024) <= b.cluster_batch(8, 1024)
+    # no actual loss (or nonsense "growth"): the budget is untouched
+    assert budget_mod.degraded_budget(b, 2, 2) is b
+    assert budget_mod.degraded_budget(b, 4, 2) is b
+    # a second loss compounds against the CURRENT budget
+    dd = budget_mod.degraded_budget(d, 1, 2)
+    assert dd.hbm_gb == pytest.approx(4.0)
+
+
+def test_node_sharding_plan_pairs_producer_and_consumer():
+    """The production graph's declared Edge.sharding specs resolve to a
+    per-node plan where every declared hbm edge carries the SAME axis on
+    its producer's out map and each consumer's in map — the pjit
+    discipline the executor publishes as ctx.node_shardings."""
+    from ont_tcrconsensus_tpu.graph import pipeline as graph_pipeline
+    from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+
+    cfg = RunConfig.from_dict({"reference_file": "r.fa",
+                               "fastq_pass_dir": "fq"})
+    spec = graph_pipeline.build_library_graph(cfg)
+    m = mesh_mod.make_mesh({"data": 2})
+    plan = mesh_mod.node_sharding_plan(spec, m)
+    assert plan, "production graph declares no sharded edges"
+    for name, maps in plan.items():
+        for e, axis in list(maps["out"].items()) + list(maps["in"].items()):
+            assert spec.edges[e].sharding == axis
+            sh = mesh_mod.axis_sharding(m, axis, ndim=2)
+            assert sh.spec == jax.sharding.PartitionSpec(axis, None)
+        for e, axis in maps["out"].items():
+            for omaps in plan.values():
+                if e in omaps["in"]:
+                    assert omaps["in"][e] == axis

@@ -325,6 +325,61 @@ def test_pipeline_empty_and_zero_survivor_libraries(tmp_path):
         assert not (lib_dir / "quarantine.fastq.gz").exists()
 
 
+def _mesh_artifacts(tmp, tmp_path, name, mesh_shape):
+    """Run the library under ``mesh_shape`` in a fresh root; return the
+    bytes of the counts CSV and merged consensus FASTA."""
+    import shutil
+
+    root = tmp_path / name
+    shutil.copytree(tmp / "fastq_pass" / "barcode01",
+                    root / "fastq_pass" / "barcode01")
+    shutil.copy(tmp / "reference.fa", root / "reference.fa")
+    cfg = _base_config(root)
+    cfg.mesh_shape = mesh_shape
+    run_with_config(cfg)
+    lib_dir = root / "fastq_pass" / "nano_tcr" / "barcode01"
+    return {
+        "counts": (lib_dir / "counts" / "umi_consensus_counts.csv").read_bytes(),
+        "fasta": (lib_dir / "fasta" / "merged_consensus.fasta").read_bytes(),
+    }
+
+
+def _baseline_artifacts(tmp):
+    """The unsharded module-baseline artifacts (written by
+    test_pipeline_counts_match_ground_truth, which runs first in file
+    order — the same reuse test_pipeline_consensus_sequences_exact
+    relies on)."""
+    lib_dir = tmp / "fastq_pass" / "nano_tcr" / "barcode01"
+    return {
+        "counts": (lib_dir / "counts" / "umi_consensus_counts.csv").read_bytes(),
+        "fasta": (lib_dir / "fasta" / "merged_consensus.fasta").read_bytes(),
+    }
+
+
+@pytest.mark.slow
+def test_pipeline_mesh_data2_byte_identical_to_unsharded(sim_library, tmp_path):
+    """Sharded execution is an implementation detail: a data=2 mesh run
+    must reproduce the unsharded run's counts CSV and consensus FASTA
+    byte-for-byte (the sharded kernels are bitwise-equal per chip, and
+    stage boundaries never reshard)."""
+    tmp, _ = sim_library
+    want = _baseline_artifacts(tmp)
+    got = _mesh_artifacts(tmp, tmp_path, "mesh_d2", {"data": 2})
+    assert got == want, "data=2 artifacts diverged from the unsharded run"
+
+
+@pytest.mark.slow
+def test_pipeline_mesh_scaling_sweep_byte_identical(sim_library, tmp_path):
+    """The full ISSUE-18 equivalence sweep: data=1, 4 and 8 all produce
+    artifacts byte-identical to the unsharded baseline (data=2 is the
+    non-slow arm above)."""
+    tmp, _ = sim_library
+    want = _baseline_artifacts(tmp)
+    for n in (1, 4, 8):
+        got = _mesh_artifacts(tmp, tmp_path, f"mesh_d{n}", {"data": n})
+        assert got == want, f"data={n} artifacts diverged"
+
+
 def test_mesh_batch_divisibility_validated(sim_library):
     tmp, _ = sim_library
     cfg = _base_config(tmp)

@@ -41,6 +41,36 @@ def test_oom_markers_win_over_transient_markers():
     assert retry.classify(exc) == "oom"
 
 
+def test_classify_device_lost_outranks_everything():
+    assert retry.classify(faults.DeviceLostChaosError("x")) == "device_lost"
+    assert retry.classify(RuntimeError("DEVICE_LOST: slice 3")) == "device_lost"
+    # a dead device's message may also carry transport/allocator markers;
+    # the device being gone is the binding fact
+    assert retry.classify(
+        RuntimeError("UNAVAILABLE: device halted")) == "device_lost"
+    assert retry.classify(
+        RuntimeError("Device lost during RESOURCE_EXHAUSTED cleanup")
+    ) == "device_lost"
+
+
+def test_call_with_retry_escalates_device_lost():
+    """A dead slice can be neither retried nor shrunk around: the fault
+    escalates immediately (one attempt, outcome "escalated") to the graph
+    executor's degraded-mesh loop."""
+    rec = retry.RobustnessRecorder()
+    calls = []
+
+    def dead():
+        calls.append(1)
+        raise faults.DeviceLostChaosError("DEVICE_LOST: slice gone")
+
+    with pytest.raises(faults.DeviceLostChaosError):
+        retry.call_with_retry("site", dead, recorder=rec, sleep=lambda s: None)
+    assert len(calls) == 1  # never retried on the broken mesh
+    assert rec.events[-1]["classification"] == "device_lost"
+    assert rec.events[-1]["outcome"] == "escalated"
+
+
 # --- retry policy -----------------------------------------------------------
 
 
