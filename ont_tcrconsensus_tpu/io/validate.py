@@ -31,11 +31,12 @@ import dataclasses
 import gzip
 import os
 import sys
-import threading
 import zlib
 from collections.abc import Iterator
 
 import numpy as np
+
+from ont_tcrconsensus_tpu.robustness import lockcheck
 
 # Canonical malformation reasons — byte-for-byte identical to the kReason*
 # strings in io/native/fastx_parser.cpp (the fuzzer pins this).
@@ -331,7 +332,7 @@ class IngestGuard:
         self._finalized = False
         # bad records arrive on the ingest prefetch worker thread while
         # reset() (the transient-retry hook) runs on the main thread
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock()
         self.reset()
 
     def reset(self) -> None:
@@ -362,6 +363,7 @@ class IngestGuard:
             self.handle(BadRecord(offset, reason, raw, self.source))
 
     def _close_locked(self) -> None:
+        lockcheck.assert_held(self._lock, "IngestGuard._close_locked")
         if self._fh is not None:
             self._fh.close()
             self._fh = None

@@ -58,7 +58,7 @@ from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ont_tcrconsensus_tpu.obs import history, metrics, trace
-from ont_tcrconsensus_tpu.robustness import watchdog
+from ont_tcrconsensus_tpu.robustness import lockcheck, watchdog
 
 #: flight-recorder ring capacity. Sized for "the last few minutes of a
 #: wedged run": heartbeats are per-batch/per-chunk (not per-read), so 512
@@ -83,7 +83,7 @@ class FlightRecorder:
     """
 
     def __init__(self, max_events: int = MAX_RING_EVENTS):
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock()
         self.t0_wall = time.time()
         self.t0_mono = time.monotonic()
         self.max_events = max_events
@@ -93,6 +93,7 @@ class FlightRecorder:
         self.last_flush: dict | None = None
 
     def _add_locked(self, ev: dict) -> None:
+        lockcheck.assert_held(self._lock, "FlightRecorder._add_locked")
         ev["thread"] = threading.current_thread().name
         self.events.append(ev)
         self.total += 1
@@ -185,7 +186,7 @@ class ProgressTracker:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock()
         self.t0_mono = time.monotonic()
         self.libraries_total = 0
         self.libraries_done = 0
@@ -255,6 +256,7 @@ class ProgressTracker:
             self.done.add(name)
 
     def _node_est_locked(self, name: str, est: dict, avg: float) -> float:
+        lockcheck.assert_held(self._lock, "ProgressTracker._node_est_locked")
         v = est.get(name)
         if v is None:
             return avg
@@ -309,26 +311,9 @@ class ProgressTracker:
             }
 
 
-# Lock-ownership declaration for graftlint's lock-discipline rule: the
-# ring is fed from every guarded stage thread plus overlap workers while
-# HTTP handler threads snapshot it; the tracker is fed from the main loop
-# and read by handler threads.
-LOCK_OWNERSHIP = {
-    "FlightRecorder.events": "_lock",
-    "FlightRecorder.total": "_lock",
-    "FlightRecorder.flush_path": "_lock",
-    "FlightRecorder.last_flush": "_lock",
-    "ProgressTracker.libraries_total": "_lock",
-    "ProgressTracker.libraries_done": "_lock",
-    "ProgressTracker.library": "_lock",
-    "ProgressTracker.plan": "_lock",
-    "ProgressTracker.done": "_lock",
-    "ProgressTracker.node": "_lock",
-    "ProgressTracker.node_units": "_lock",
-    "ProgressTracker.node_t0": "_lock",
-    "ProgressTracker.node_seconds": "_lock",
-    "ProgressTracker.priors": "_lock",
-}
+# Lock ownership for FlightRecorder / ProgressTracker is declared in the
+# consolidated registry (ont_tcrconsensus_tpu/robustness/locks.py)
+# consumed by graftlint's lock-discipline rule and graftrace.
 
 
 def load_node_priors(ledger_paths: list[str],

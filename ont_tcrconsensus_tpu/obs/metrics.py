@@ -26,15 +26,16 @@ per-library TSVs.
 
 from __future__ import annotations
 
-import threading
 import time
+
+from ont_tcrconsensus_tpu.robustness import lockcheck
 
 
 class MetricsRegistry:
     """Thread-safe per-run metric store; see :func:`arm`."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock()
         self.t0_wall = time.time()
         self.t0_mono = time.monotonic()
         self.counters: dict[str, float] = {}
@@ -562,33 +563,9 @@ def prom_label(value: str) -> str:
             .replace("\n", "\\n"))
 
 
-# Lock-ownership declaration for graftlint's lock-discipline rule: every
-# mutation of these registries outside `with self._lock:` is a data race
-# (worker threads + the watchdog monitor both feed this object).
-LOCK_OWNERSHIP = {
-    "MetricsRegistry.counters": "_lock",
-    "MetricsRegistry.gauges": "_lock",
-    "MetricsRegistry.gauges_live": "_lock",
-    "MetricsRegistry.serve_rejects": "_lock",
-    "MetricsRegistry.mesh_slices": "_lock",
-    "MetricsRegistry.mesh_degraded": "_lock",
-    "MetricsRegistry.hists": "_lock",
-    "MetricsRegistry.stages": "_lock",
-    "MetricsRegistry.dispatch": "_lock",
-    "MetricsRegistry.dispatch_stages": "_lock",
-    "MetricsRegistry.compiles": "_lock",
-    "MetricsRegistry.graph_nodes": "_lock",
-    "MetricsRegistry.graph_edges": "_lock",
-    "MetricsRegistry.graph_meta": "_lock",
-    "MetricsRegistry.pools": "_lock",
-    "MetricsRegistry.analysis": "_lock",
-    "MetricsRegistry.transfers": "_lock",
-    "MetricsRegistry.edge_transfers": "_lock",
-    "MetricsRegistry.donations": "_lock",
-    "MetricsRegistry.node_hbm": "_lock",
-    "MetricsRegistry.static_hbm": "_lock",
-    "MetricsRegistry._round_trip": "_lock",
-}
+# Lock ownership for MetricsRegistry (every table -> _lock) is declared
+# in the consolidated registry (ont_tcrconsensus_tpu/robustness/locks.py)
+# consumed by graftlint's lock-discipline rule and graftrace.
 
 
 # --- process-wide armed registry (same discipline as faults/watchdog) -------

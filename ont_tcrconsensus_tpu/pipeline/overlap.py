@@ -40,7 +40,7 @@ import time
 
 from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
 from ont_tcrconsensus_tpu.obs import trace
-from ont_tcrconsensus_tpu.robustness import faults, watchdog
+from ont_tcrconsensus_tpu.robustness import faults, lockcheck, watchdog
 
 
 class DeferredStage:
@@ -125,21 +125,16 @@ class StageExecutor:
         # pool efficiency accounting (telemetry's overlap busy/idle split):
         # window = first submit .. last worker completion, busy = summed
         # worker wall clocks, idle = window * slots - busy
-        self._stats_lock = threading.Lock()
+        self._stats_lock = lockcheck.make_lock()
         self._t_first_submit: float | None = None
         self._t_last_done: float | None = None
         self._busy_s = 0.0
         self._pool_recorded = False
 
-    # Lock-ownership declaration for graftlint's lock-discipline rule:
-    # the pool counters are fed by every worker thread's completion
-    # callback, so an unlocked write silently loses busy seconds.
-    LOCK_OWNERSHIP = {
-        "StageExecutor._t_first_submit": "_stats_lock",
-        "StageExecutor._t_last_done": "_stats_lock",
-        "StageExecutor._busy_s": "_stats_lock",
-        "StageExecutor._pool_recorded": "_stats_lock",
-    }
+    # Lock ownership for the pool counters (-> _stats_lock) is declared
+    # in the consolidated registry (robustness/locks.py) consumed by
+    # graftlint's lock-discipline rule and graftrace; _pending is in
+    # LOCK_EXEMPT there (main-thread only).
 
     def _note_done(self, worker_seconds: float) -> None:
         with self._stats_lock:

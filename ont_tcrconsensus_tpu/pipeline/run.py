@@ -52,6 +52,7 @@ from ont_tcrconsensus_tpu.qc.timing import StageTimer
 from ont_tcrconsensus_tpu.robustness import (
     contracts,
     faults,
+    lockcheck,
     retry,
     shutdown,
     watchdog,
@@ -236,6 +237,10 @@ def _run_with_config(cfg: RunConfig, polisher=None) -> dict[str, dict[str, int]]
     # restores the pre-run SIGQUIT disposition. An embedder's next
     # run_with_config call must never inherit this run's deadline monitor
     # or dump handler.
+    # Runtime lockset twin (TCR_LOCKCHECK=1): must arm BEFORE any guarded
+    # object is constructed — the watchdog below and the metrics/live
+    # registries armed inside the try choose their lock type at __init__.
+    lockcheck.arm_from_env()
     wd = None
     if cfg.stage_timeout_s:
         wd = watchdog.Watchdog(base_timeout_s=cfg.stage_timeout_s)

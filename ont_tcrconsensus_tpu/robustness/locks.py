@@ -1,0 +1,109 @@
+"""Process-wide lock-ownership registry: every cross-thread shared
+location in the tree and the lock that owns it, in ONE table.
+
+This used to live as five scattered ``LOCK_OWNERSHIP`` dicts next to
+their classes (serve/queue.py, obs/metrics.py, obs/live.py,
+pipeline/overlap.py, robustness/watchdog.py). Consolidating them here
+gives every analyzer one source of truth:
+
+- graftlint's ``lock-discipline`` rule (lexical: a mutation of a
+  declared attr outside ``with self.<lock>:`` is a finding) merges
+  every ``LOCK_OWNERSHIP`` dict literal it can see, so it consumes this
+  table with no rule change;
+- graftlint's ``lock-registry`` sweep checks BOTH directions — a
+  declared attr that no longer exists in its class, and an undeclared
+  mutable container in a registered class — so the table cannot rot
+  (same discipline as the chaos/obs site cross-checks);
+- graftrace (tools/graftrace) reads it as the shared-location universe
+  for Eraser-style lockset analysis across thread roots;
+- the runtime twin (robustness/lockcheck.py, ``TCR_LOCKCHECK=1``) arms
+  owner-assertions on exactly these locks.
+
+Keys are ``"ClassName.attr"``; values are the lock attribute on the same
+object that must be held for every access. Module-level globals that are
+only ever REBOUND (``_ACTIVE = wd`` style atomic-reference hand-off) are
+deliberately absent: rebinding is atomic under the GIL and is the
+documented arming discipline — only container *mutations* need a lock.
+"""
+
+from __future__ import annotations
+
+LOCK_OWNERSHIP = {
+    # --- serve/queue.py: HTTP handler threads and the daemon loop both
+    # mutate these; any mutation outside the lock loses jobs under load
+    "JobQueue.pending": "_lock",
+    "JobQueue.jobs": "_lock",
+    "JobQueue.finished_order": "_lock",
+    # --- obs/metrics.py: worker threads + the watchdog monitor both
+    # feed this object
+    "MetricsRegistry.counters": "_lock",
+    "MetricsRegistry.gauges": "_lock",
+    "MetricsRegistry.gauges_live": "_lock",
+    "MetricsRegistry.serve_rejects": "_lock",
+    "MetricsRegistry.mesh_slices": "_lock",
+    "MetricsRegistry.mesh_degraded": "_lock",
+    "MetricsRegistry.hists": "_lock",
+    "MetricsRegistry.stages": "_lock",
+    "MetricsRegistry.dispatch": "_lock",
+    "MetricsRegistry.dispatch_stages": "_lock",
+    "MetricsRegistry.compiles": "_lock",
+    "MetricsRegistry.graph_nodes": "_lock",
+    "MetricsRegistry.graph_edges": "_lock",
+    "MetricsRegistry.graph_meta": "_lock",
+    "MetricsRegistry.pools": "_lock",
+    "MetricsRegistry.analysis": "_lock",
+    "MetricsRegistry.transfers": "_lock",
+    "MetricsRegistry.edge_transfers": "_lock",
+    "MetricsRegistry.donations": "_lock",
+    "MetricsRegistry.node_hbm": "_lock",
+    "MetricsRegistry.static_hbm": "_lock",
+    "MetricsRegistry._round_trip": "_lock",
+    # --- obs/live.py: the ring is fed from every guarded stage thread
+    # plus overlap workers while HTTP handler threads snapshot it; the
+    # tracker is fed from the main loop and read by handler threads
+    "FlightRecorder.events": "_lock",
+    "FlightRecorder.total": "_lock",
+    "FlightRecorder.flush_path": "_lock",
+    "FlightRecorder.last_flush": "_lock",
+    "ProgressTracker.libraries_total": "_lock",
+    "ProgressTracker.libraries_done": "_lock",
+    "ProgressTracker.library": "_lock",
+    "ProgressTracker.plan": "_lock",
+    "ProgressTracker.done": "_lock",
+    "ProgressTracker.node": "_lock",
+    "ProgressTracker.node_units": "_lock",
+    "ProgressTracker.node_t0": "_lock",
+    "ProgressTracker.node_seconds": "_lock",
+    "ProgressTracker.priors": "_lock",
+    # --- pipeline/overlap.py: the pool counters are fed by every worker
+    # thread's completion callback; an unlocked write loses busy seconds
+    "StageExecutor._t_first_submit": "_stats_lock",
+    "StageExecutor._t_last_done": "_stats_lock",
+    "StageExecutor._busy_s": "_stats_lock",
+    "StageExecutor._pool_recorded": "_stats_lock",
+    # --- robustness/watchdog.py: mutated by guarded stage threads and
+    # raced by the monitor; _on_hard's cancel-safety proof relies on
+    # every write being locked
+    "Watchdog._entries": "_lock",
+}
+
+#: Mutable containers on registered classes that are deliberately NOT
+#: lock-owned, with the one-line reason the analyzers echo. The
+#: lock-registry sweep fails on any undeclared container that is in
+#: neither table, so "forgot to think about it" is impossible.
+LOCK_EXEMPT = {
+    "StageExecutor._pending": (
+        "main-thread only: submit/commit/wait_all all run on the "
+        "library loop thread; workers never touch the pending list"
+    ),
+}
+
+
+def ownership_by_class() -> dict[str, dict[str, str]]:
+    """``{"JobQueue": {"pending": "_lock", ...}, ...}`` for runtime
+    consumers (the AST analyzers parse the literal instead)."""
+    out: dict[str, dict[str, str]] = {}
+    for key, lock in LOCK_OWNERSHIP.items():
+        cls, attr = key.split(".", 1)
+        out.setdefault(cls, {})[attr] = lock
+    return out

@@ -47,6 +47,8 @@ import sys
 import threading
 import time
 
+from ont_tcrconsensus_tpu.robustness import lockcheck
+
 #: soft deadline (stall REPORT) as a fraction of the hard deadline (CANCEL)
 SOFT_FRACTION = 0.5
 
@@ -129,7 +131,7 @@ class Watchdog:
         )
         self.log_path = log_path
         self._entries: dict[int, _StageEntry] = {}
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -336,12 +338,9 @@ class Watchdog:
                     f"watchdog: expiry sink failed: {exc!r}\n")
 
 
-# Lock-ownership declaration for graftlint's lock-discipline rule: the
-# registry is mutated by guarded stage threads and raced by the monitor,
-# and _on_hard's cancel-safety proof relies on every write being locked.
-LOCK_OWNERSHIP = {
-    "Watchdog._entries": "_lock",
-}
+# Lock ownership for Watchdog._entries (-> _lock) is declared in the
+# consolidated registry (ont_tcrconsensus_tpu/robustness/locks.py)
+# consumed by graftlint's lock-discipline rule and graftrace.
 
 
 # --- process-wide active watchdog (same discipline as faults/retry) ---------

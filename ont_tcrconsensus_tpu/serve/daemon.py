@@ -44,6 +44,7 @@ from ont_tcrconsensus_tpu.obs import live as obs_live
 from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
 from ont_tcrconsensus_tpu.pipeline.config import RunConfig
 from ont_tcrconsensus_tpu.robustness import faults
+from ont_tcrconsensus_tpu.robustness import lockcheck
 from ont_tcrconsensus_tpu.robustness import retry as retry_mod
 from ont_tcrconsensus_tpu.robustness import shutdown
 from ont_tcrconsensus_tpu.robustness import watchdog as watchdog_mod
@@ -72,6 +73,9 @@ class Daemon:
     def __init__(self, template: dict, *, port: int, state_dir: str,
                  queue_max: int | None = None, do_prewarm: bool | None = None,
                  prewarm_widths: list[int] | None = None):
+        # runtime lockset twin: arm before the JobQueue (and later the
+        # daemon-owned metrics/live registries) pick their lock type
+        lockcheck.arm_from_env()
         self.template = dict(template)
         # the template must itself be a complete, valid run config: every
         # job inherits it, so a broken template fails at daemon start, not

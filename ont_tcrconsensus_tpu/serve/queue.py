@@ -33,6 +33,7 @@ from ont_tcrconsensus_tpu.io import bucketing
 from ont_tcrconsensus_tpu.obs import metrics
 from ont_tcrconsensus_tpu.parallel.budget import BudgetModel
 from ont_tcrconsensus_tpu.robustness import faults
+from ont_tcrconsensus_tpu.robustness import lockcheck
 
 JOURNAL_SCHEMA = 1
 JOURNAL_BASENAME = "serve_journal.json"
@@ -141,14 +142,14 @@ class JobQueue:
 
     Thread contract: the HTTP handler threads submit and snapshot; the
     daemon loop pops and mutates job state through :meth:`mark`. One lock
-    guards every structure (declared for graftlint's lock-discipline rule
-    below); the condition wakes the pop side on submit/requeue.
+    guards every structure (declared in robustness/locks.py for the lock
+    analyzers); the condition wakes the pop side on submit/requeue.
     """
 
     def __init__(self, max_depth: int, budget: BudgetModel):
         self.max_depth = int(max_depth)
         self.budget = budget
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock()
         self._nonempty = threading.Condition(self._lock)
         self.pending: list[Job] = []
         self.jobs: dict[str, Job] = {}
@@ -287,13 +288,9 @@ class JobQueue:
             return list(self.pending)
 
 
-# graftlint lock-discipline: HTTP handler threads and the daemon loop both
-# mutate these; any mutation outside the lock loses jobs under load
-LOCK_OWNERSHIP = {
-    "JobQueue.pending": "_lock",
-    "JobQueue.jobs": "_lock",
-    "JobQueue.finished_order": "_lock",
-}
+# Lock ownership for JobQueue is declared in the consolidated registry
+# (ont_tcrconsensus_tpu/robustness/locks.py) consumed by graftlint's
+# lock-discipline rule and graftrace's lockset analysis.
 
 
 # --- drain journal ------------------------------------------------------------

@@ -6,7 +6,11 @@
 #
 # Stage 0 is static analysis: graftlint (tools/graftlint — repo-native AST
 # rules: jit hygiene, exception-guard safety, chaos-site and config-field
-# cross-checks) and ruff (curated pyflakes/bare-except set in
+# cross-checks), graftcheck (semantic graph contracts), graftrace
+# (tools/graftrace — whole-program Eraser-style lockset race/deadlock
+# analysis over every thread root, verdict recorded in the run-history
+# ledger; its dynamic twin is TCR_LOCKCHECK=1, exercised by the chaos
+# e2e) and ruff (curated pyflakes/bare-except set in
 # pyproject.toml; skipped with a notice when the container doesn't ship
 # ruff). Stage 1 is the exact ROADMAP tier-1 command: the full non-slow
 # suite on the CPU backend (this already includes the non-slow chaos
@@ -73,6 +77,50 @@ if [ "$jrc" -ne "$gcrc" ] || [ "$jbody_rc" != "$gcrc" ]; then
     echo "graftcheck --json parity FAILED (human rc=$gcrc, json rc=$jrc," \
          "body exit_code=$jbody_rc)" >&2
     exit 1
+fi
+
+echo "--- static analysis: graftrace (whole-program lockset race/deadlock"
+echo "    analyzer over the thread roots; jax-free; --expect pins the"
+echo "    justified signal-path findings so a new race, order inversion,"
+echo "    blocking-under-lock or signal-unsafe call fails CI) ---"
+python -m tools.graftrace --expect
+trrc=$?
+if [ "$trrc" -ne 0 ]; then
+    echo "graftrace FAILED (rc=$trrc)" >&2
+    exit "$trrc"
+fi
+# same exit-code/JSON parity contract as graftcheck
+trjson=$(python -m tools.graftrace --expect --json)
+tjrc=$?
+tjbody_rc=$(printf '%s' "$trjson" | python -c \
+    'import json,sys; print(json.load(sys.stdin)["exit_code"])')
+if [ "$tjrc" -ne "$trrc" ] || [ "$tjbody_rc" != "$trrc" ]; then
+    echo "graftrace --json parity FAILED (human rc=$trrc, json rc=$tjrc," \
+         "body exit_code=$tjbody_rc)" >&2
+    exit 1
+fi
+# record the verdict in the run-history ledger (source=graftrace entries
+# carry no perf fingerprint, so they never pollute perf-gate baselines)
+mkdir -p .scratch
+GRAFTRACE_JSON="$trjson" env JAX_PLATFORMS=cpu python - <<'EOF'
+import json, os
+body = json.loads(os.environ["GRAFTRACE_JSON"])
+from ont_tcrconsensus_tpu.obs import history
+entry = history.build_entry("graftrace", sha=history.git_sha(), extra={
+    "graftrace": {
+        "new_findings": body["count"],
+        "baselined": len(body["baselined"]),
+        "stale_expected": len(body["stale_expected"]),
+        "roots": len(body["roots"]),
+        "exit_code": body["exit_code"],
+    },
+})
+history.append_entry(".scratch/history.jsonl", entry)
+EOF
+hrc=$?
+if [ "$hrc" -ne 0 ]; then
+    echo "graftrace ledger record FAILED (rc=$hrc)" >&2
+    exit "$hrc"
 fi
 
 if command -v ruff >/dev/null 2>&1; then

@@ -618,3 +618,35 @@ def test_chaos_disarmed_run_writes_clean_report(chaos_lib):
     handler = signal.getsignal(signal.SIGTERM)
     owner = getattr(handler, "__self__", None)
     assert not isinstance(owner, shutdown.ShutdownCoordinator)
+
+
+def test_chaos_lockcheck_armed_run_byte_identical(chaos_lib, tmp_path,
+                                                  monkeypatch):
+    """TCR_LOCKCHECK=1 — the dynamic half of the graftrace proof
+    (tools/graftrace): every LOCK_OWNERSHIP lock becomes an RLock with
+    runtime owner-assertions at the *_locked contract boundaries. A full
+    armed run must report ZERO violations and reproduce the
+    uninterrupted baseline byte-for-byte (arming may not change
+    behavior, only observe it)."""
+    from ont_tcrconsensus_tpu.robustness import lockcheck
+
+    root = tmp_path / "lockcheck"
+    _stage_inputs(chaos_lib["inputs"], root)
+    monkeypatch.setenv(lockcheck.ENV_VAR, "1")
+    lockcheck.reset()
+    try:
+        results = run_with_config(_cfg(root))  # arms itself from the env
+        assert lockcheck.armed()
+        assert results["barcode01"] == chaos_lib["baseline_counts"]
+        _assert_byte_identical(chaos_lib, root)
+        assert lockcheck.violations() == []
+        # negative control: the instrumentation bites when the *_locked
+        # contract is actually breached (this is not a silent no-op pass)
+        from ont_tcrconsensus_tpu.obs.live import FlightRecorder
+        rec = FlightRecorder(max_events=4)
+        rec._add_locked({"name": "breach"})
+        assert any("FlightRecorder._add_locked" in v
+                   for v in lockcheck.violations())
+    finally:
+        lockcheck.disarm()
+        lockcheck.reset()

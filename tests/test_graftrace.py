@@ -1,0 +1,548 @@
+"""graftrace (tools/graftrace): every finding class fires on a seeded
+fixture, root discovery sees every spawn mechanism, the CLI honours the
+graftcheck --expect contract, and the SHIPPED tree is clean modulo the
+justified expected list.
+
+Two fixtures reproduce shipped bug shapes: the PR 5 watchdog
+cancel-vs-scope-exit race (an unlocked ``pop`` on a registry table the
+monitor thread mutates under its lock) and a two-lock AB/BA order
+inversion. The dynamic twin (robustness/lockcheck) is unit-tested here
+too; its whole-pipeline proof rides the chaos e2e in test_chaos.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from ont_tcrconsensus_tpu.robustness import lockcheck  # noqa: E402
+from tools.graftrace.cli import DEFAULT_EXPECT, analyze_paths  # noqa: E402
+from tools.graftrace.cli import main as graftrace_main  # noqa: E402
+
+
+def trace(tmp_path, files: dict[str, str]):
+    """Write a fixture tree, analyze it, return (findings, roots)."""
+    for rel, source in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(source)
+    return analyze_paths([str(tmp_path)])
+
+
+def rules_of(findings) -> set[str]:
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# race-unlocked-write — the PR 5 cancel-vs-scope-exit shape
+
+
+_WATCHDOG_RACE = (
+    "import threading\n"
+    "\n"
+    'LOCK_OWNERSHIP = {"Watchdog._entries": "_lock"}\n'
+    "\n"
+    "\n"
+    "class Watchdog:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._entries = {}\n"
+    "\n"
+    "    def start(self):\n"
+    "        threading.Thread(target=self._monitor, daemon=True).start()\n"
+    "\n"
+    "    def _monitor(self):\n"
+    "        with self._lock:\n"
+    '            self._entries["beat"] = 1\n'
+    "\n"
+    "    def cancel(self, name):\n"
+    "        self._entries.pop(name, None)  # seeded: forgot the lock\n"
+    "\n"
+    "\n"
+    "def _run_with_config():\n"
+    "    wd = Watchdog()\n"
+    "    wd.start()\n"
+    '    wd.cancel("x")\n'
+)
+
+
+def test_race_unlocked_write_fires_on_pr5_cancel_shape(tmp_path):
+    findings, roots = trace(tmp_path, {"pipeline/run.py": _WATCHDOG_RACE})
+    assert rules_of(findings) == {"race-unlocked-write"}
+    (f,) = findings
+    assert "Watchdog._entries" in f.message
+    assert "main:pipeline-loop" in f.message
+    assert "thread:Watchdog._monitor" in f.message
+    # anchored at the unlocked write, not the guarded one
+    assert "pop" in (tmp_path / "pipeline/run.py").read_text().splitlines()[
+        f.line - 1]
+
+
+def test_race_needs_two_roots(tmp_path):
+    """The same unlocked write is NOT a race when only one root reaches
+    the location (no spawn site -> single-threaded by construction)."""
+    single = _WATCHDOG_RACE.replace(
+        "    wd.start()\n", "").replace(
+        "    def start(self):\n"
+        "        threading.Thread(target=self._monitor, daemon=True)"
+        ".start()\n\n", "")
+    findings, _ = trace(tmp_path, {"pipeline/run.py": single})
+    assert findings == []
+
+
+def test_race_cleared_by_taking_the_lock(tmp_path):
+    fixed = _WATCHDOG_RACE.replace(
+        "        self._entries.pop(name, None)  # seeded: forgot the lock",
+        "        with self._lock:\n"
+        "            self._entries.pop(name, None)")
+    findings, _ = trace(tmp_path, {"pipeline/run.py": fixed})
+    assert findings == []
+
+
+def test_unlocked_reads_tolerated_by_doctrine(tmp_path):
+    """Registries tolerate torn reads for display: a lock-free *read*
+    from a second root must not flag when every write is guarded."""
+    readers = _WATCHDOG_RACE.replace(
+        "        self._entries.pop(name, None)  # seeded: forgot the lock",
+        "        return len(self._entries)")
+    findings, _ = trace(tmp_path, {"pipeline/run.py": readers})
+    assert findings == []
+
+
+def test_race_on_module_level_table(tmp_path):
+    """Module-global container mutations race too; plain rebinds are the
+    exempt atomic-reference hand-off and must not count as writes."""
+    findings, _ = trace(tmp_path, {"pipeline/run.py": (
+        "import threading\n"
+        "_JOBS = {}\n"
+        "_ACTIVE = None\n"
+        "def worker():\n"
+        "    _JOBS['k'] = 1\n"
+        "def _run_with_config():\n"
+        "    global _ACTIVE\n"
+        "    threading.Thread(target=worker, daemon=True).start()\n"
+        "    _JOBS['m'] = 2\n"
+        "    _ACTIVE = object()  # rebind: exempt\n"
+    )})
+    assert rules_of(findings) == {"race-unlocked-write"}
+    (f,) = findings
+    assert "_JOBS" in f.message and "_ACTIVE" not in f.message
+
+
+# ---------------------------------------------------------------------------
+# deadlock-order-inversion — seeded two-lock AB/BA cycle
+
+
+_TWO_LOCK = (
+    "import threading\n"
+    "LOCK_A = threading.Lock()\n"
+    "LOCK_B = threading.Lock()\n"
+    "def forward():\n"
+    "    with LOCK_A:\n"
+    "        with LOCK_B:\n"
+    "            pass\n"
+    "def backward():\n"
+    "    with LOCK_B:\n"
+    "        with LOCK_A:\n"
+    "            pass\n"
+    "def worker():\n"
+    "    backward()\n"
+    "def _run_with_config():\n"
+    "    threading.Thread(target=worker, daemon=True).start()\n"
+    "    forward()\n"
+)
+
+
+def test_deadlock_order_inversion_fires(tmp_path):
+    findings, _ = trace(tmp_path, {"pipeline/run.py": _TWO_LOCK})
+    assert rules_of(findings) == {"deadlock-order-inversion"}
+    (f,) = findings
+    assert "LOCK_A" in f.message and "LOCK_B" in f.message
+    assert "->" in f.message  # witness edges with sites
+
+
+def test_consistent_lock_order_is_clean(tmp_path):
+    consistent = _TWO_LOCK.replace(
+        "    with LOCK_B:\n"
+        "        with LOCK_A:\n",
+        "    with LOCK_A:\n"
+        "        with LOCK_B:\n", 1).replace(
+        "def backward():\n"
+        "    with LOCK_A:\n", "def backward():\n    with LOCK_A:\n")
+    findings, _ = trace(tmp_path, {"pipeline/run.py": consistent})
+    assert findings == []
+
+
+def test_order_edges_cross_object_boundaries(tmp_path):
+    """A method that calls into another object while holding its own lock
+    contributes an interprocedural edge (the JobQueue->Metrics shape);
+    the worker reaches the queue through a typed module global, the way
+    armed singletons are published in the real tree."""
+    findings, _ = trace(tmp_path, {"pipeline/run.py": (
+        "import threading\n"
+        "class Registry:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.q = Queue()\n"
+        "    def add(self):\n"
+        "        with self._lock:\n"
+        "            self.q.ping()\n"
+        "class Queue:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def ping(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+        "    def submit_side(self):\n"
+        "        with self._lock:\n"
+        "            _REG.add()\n"
+        '_REG: "Registry | None" = None\n'
+        '_Q: "Queue | None" = None\n'
+        "def worker():\n"
+        "    _Q.submit_side()\n"
+        "def _run_with_config():\n"
+        "    threading.Thread(target=worker, daemon=True).start()\n"
+        "    _REG.add()\n"
+    )})
+    assert rules_of(findings) == {"deadlock-order-inversion"}
+    (f,) = findings
+    assert "Queue._lock" in f.message and "Registry._lock" in f.message
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock / signal-unsafe-call
+
+
+def test_blocking_under_lock_fires(tmp_path):
+    findings, _ = trace(tmp_path, {"pipeline/run.py": (
+        "import threading, time\n"
+        "LOCK = threading.Lock()\n"
+        "def _run_with_config():\n"
+        "    with LOCK:\n"
+        "        time.sleep(1)\n"
+        "        open('x').read()\n"
+    )})
+    assert rules_of(findings) == {"blocking-under-lock"}
+    assert len(findings) == 2
+    assert all("LOCK" in f.message for f in findings)
+
+
+def test_condition_wait_on_held_lock_exempt(tmp_path):
+    """Condition.wait RELEASES the held lock while waiting — the JobQueue
+    pop pattern must not flag."""
+    findings, _ = trace(tmp_path, {"pipeline/run.py": (
+        "import threading\n"
+        "class Q:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._cv = threading.Condition(self._lock)\n"
+        "    def pop(self):\n"
+        "        with self._cv:\n"
+        "            self._cv.wait(0.1)\n"
+        "def _run_with_config():\n"
+        "    Q().pop()\n"
+    )})
+    assert findings == []
+
+
+def test_signal_unsafe_call_fires(tmp_path):
+    findings, _ = trace(tmp_path, {"pipeline/run.py": (
+        "import signal, threading\n"
+        "LOCK = threading.Lock()\n"
+        "def handler(sig, frame):\n"
+        "    with LOCK:\n"
+        "        pass\n"
+        "def _run_with_config():\n"
+        "    signal.signal(signal.SIGUSR1, handler)\n"
+    )})
+    assert rules_of(findings) == {"signal-unsafe-call"}
+    (f,) = findings
+    assert "signal:run.handler" in f.message
+
+
+# ---------------------------------------------------------------------------
+# root discovery & traversal mechanics
+
+
+def test_root_inventory_sees_every_spawn_mechanism(tmp_path):
+    _, roots = trace(tmp_path, {
+        "pipeline/run.py": (
+            "import signal, threading\n"
+            "from concurrent.futures import ThreadPoolExecutor\n"
+            "def worker():\n"
+            "    pass\n"
+            "def handler(sig, frame):\n"
+            "    pass\n"
+            "def _run_with_config():\n"
+            "    threading.Thread(target=worker).start()\n"
+            "    ThreadPoolExecutor(2).submit(worker)\n"
+            "    signal.signal(signal.SIGUSR1, handler)\n"
+        ),
+        "serve/http.py": (
+            "from http.server import BaseHTTPRequestHandler\n"
+            "class H(BaseHTTPRequestHandler):\n"
+            "    def do_GET(self):\n"
+            "        pass\n"
+        ),
+    })
+    kinds = {(r.kind, r.name) for r in roots}
+    assert ("main", "main:pipeline-loop") in kinds
+    assert ("thread", "thread:run.worker") in kinds
+    assert ("pool", "pool:run.worker") in kinds
+    assert ("signal", "signal:run.handler") in kinds
+    assert ("http", "http:H.do_GET") in kinds
+
+
+def test_unresolvable_thread_target_still_inventoried(tmp_path):
+    _, roots = trace(tmp_path, {"pipeline/run.py": (
+        "import threading\n"
+        "class S:\n"
+        "    def go(self, srv):\n"
+        "        threading.Thread(target=srv.serve_forever).start()\n"
+    )})
+    ext = [r for r in roots
+           if r.kind == "thread"]  # graftlint: disable=chaos-unknown-kind
+    assert len(ext) == 1
+    assert ext[0].func is None and "external" in ext[0].name
+
+
+def test_data_arg_submit_is_traversed_not_spawned(tmp_path):
+    """JobQueue.submit takes DATA args — graftrace must walk into it (the
+    unlocked write inside is reachable from two roots), not treat it as a
+    pool spawn site."""
+    findings, roots = trace(tmp_path, {"pipeline/run.py": (
+        "import threading\n"
+        'LOCK_OWNERSHIP = {"Q.jobs": "_lock"}\n'
+        "class Q:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.jobs = {}\n"
+        "    def submit(self, raw):\n"
+        "        self.jobs[raw] = 1  # unlocked, reached via .submit()\n"
+        '_Q: "Q | None" = None\n'
+        "def worker():\n"
+        "    _Q.submit('w')\n"
+        "def _run_with_config():\n"
+        "    q = Q()\n"
+        "    threading.Thread(target=worker, daemon=True).start()\n"
+        "    q.submit('m')\n"
+    )})
+    assert rules_of(findings) == {"race-unlocked-write"}
+    assert not any(
+        r.kind == "pool" for r in roots)  # graftlint: disable=chaos-unknown-kind
+
+
+def test_workers_start_with_empty_lockset(tmp_path):
+    """A spawner holding a lock at the spawn site must not leak that lock
+    into the worker's lockset (else every write looks guarded)."""
+    findings, _ = trace(tmp_path, {"pipeline/run.py": (
+        "import threading\n"
+        'LOCK_OWNERSHIP = {"W.table": "_lock"}\n'
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.table = {}\n"
+        "    def spawn(self):\n"
+        "        with self._lock:\n"
+        "            threading.Thread(target=self._bg, daemon=True).start()\n"
+        "    def _bg(self):\n"
+        "        self.table['k'] = 1  # unlocked: spawner's lock not ours\n"
+        "    def poke(self):\n"
+        "        with self._lock:\n"
+        "            self.table['m'] = 2\n"
+        "def _run_with_config():\n"
+        "    w = W()\n"
+        "    w.spawn()\n"
+        "    w.poke()\n"
+    )})
+    assert rules_of(findings) == {"race-unlocked-write"}
+
+
+# ---------------------------------------------------------------------------
+# CLI contract (graftcheck discipline)
+
+
+def test_cli_shipped_tree_matches_expected_list(monkeypatch, capsys):
+    monkeypatch.chdir(REPO)
+    assert graftrace_main(["--expect"]) == 0
+    out = capsys.readouterr().out
+    assert "[expected]" in out
+
+
+def test_expected_list_entries_all_justified():
+    body = json.load(open(DEFAULT_EXPECT))
+    assert body["findings"], "expected list exists but is empty?"
+    for entry in body["findings"]:
+        assert entry.get("justification", "").strip(), (
+            f"unjustified expected finding: {entry['rule']} at "
+            f"{entry['path']}:{entry['line']}")
+
+
+def test_cli_json_carries_exit_code_and_roots(monkeypatch, capsys):
+    monkeypatch.chdir(REPO)
+    assert graftrace_main(["--expect", "--json"]) == 0
+    body = json.loads(capsys.readouterr().out)
+    assert body["exit_code"] == 0
+    assert body["count"] == 0
+    assert len(body["baselined"]) == len(
+        json.load(open(DEFAULT_EXPECT))["findings"])
+    names = {r["name"] for r in body["roots"]}
+    assert "main:pipeline-loop" in names
+    assert "main:daemon-loop" in names
+    assert "thread:Watchdog._monitor" in names
+
+
+def test_cli_new_finding_fails_expect(tmp_path, capsys):
+    (tmp_path / "pipeline").mkdir(parents=True)
+    (tmp_path / "pipeline" / "run.py").write_text(_WATCHDOG_RACE)
+    expect = tmp_path / "empty.json"
+    expect.write_text('{"findings": []}')
+    rc = graftrace_main([str(tmp_path), "--expect", str(expect)])
+    assert rc == 1
+    assert "race-unlocked-write" in capsys.readouterr().out
+
+
+def test_cli_stale_expected_entry_fails(tmp_path, capsys):
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    expect = tmp_path / "stale.json"
+    expect.write_text(json.dumps({"findings": [{
+        "path": "gone.py", "rule": "race-unlocked-write",
+        "message": "fixed long ago"}]}))
+    rc = graftrace_main([str(tmp_path), "--expect", str(expect)])
+    assert rc == 1
+    assert "no longer reported" in capsys.readouterr().err
+
+
+def test_cli_roots_json(monkeypatch, capsys):
+    monkeypatch.chdir(REPO)
+    assert graftrace_main(["--roots", "--json"]) == 0
+    body = json.loads(capsys.readouterr().out)
+    assert all({"name", "kind", "func", "path", "line"} <= set(r)
+               for r in body["roots"])
+
+
+def test_cli_bad_path_is_usage_error(capsys):
+    assert graftrace_main(["definitely/not/a/path"]) == 2
+    assert "no such path" in capsys.readouterr().err
+
+
+def test_cli_never_crashes_on_unreadable_expect(tmp_path, capsys):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    assert graftrace_main([str(tmp_path), "--expect", str(bad)]) == 2
+    assert "cannot read" in capsys.readouterr().err
+
+
+def test_graftrace_is_jax_free_under_poisoned_import():
+    """The whole CLI path must run with jax IMPOSSIBLE to import."""
+    code = (
+        "import sys\n"
+        "class _Poison:\n"
+        "    def find_spec(self, name, *a, **k):\n"
+        "        if name == 'jax' or name.startswith('jax.'):\n"
+        "            raise ImportError('jax import poisoned by test')\n"
+        "sys.meta_path.insert(0, _Poison())\n"
+        "from tools.graftrace.cli import main\n"
+        "sys.exit(main(['--expect']))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "internal error" not in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# dynamic twin: robustness/lockcheck
+
+
+@pytest.fixture()
+def armed_lockcheck():
+    lockcheck.arm()
+    lockcheck.reset()
+    yield
+    lockcheck.disarm()
+    lockcheck.reset()
+
+
+def test_lockcheck_disarmed_is_inert():
+    lockcheck.disarm()
+    lockcheck.reset()
+    lock = lockcheck.make_lock()
+    assert type(lock) is type(threading.Lock())
+    lockcheck.assert_held(lock, "anything")  # no violation machinery runs
+    assert lockcheck.violations() == []
+
+
+def test_lockcheck_armed_records_unheld_entry(armed_lockcheck):
+    lock = lockcheck.make_lock()
+    lockcheck.assert_held(lock, "Fixture._locked")
+    (v,) = lockcheck.violations()
+    assert "Fixture._locked" in v and "without owning" in v
+
+
+def test_lockcheck_armed_passes_held_entry(armed_lockcheck):
+    lock = lockcheck.make_lock()
+    with lock:
+        lockcheck.assert_held(lock, "Fixture._locked")
+    assert lockcheck.violations() == []
+
+
+def test_lockcheck_armed_lock_is_condition_compatible(armed_lockcheck):
+    lock = lockcheck.make_lock()
+    cv = threading.Condition(lock)
+    with cv:
+        assert not cv.wait(0.01)  # times out, no crash: RLock works
+
+
+def test_lockcheck_skips_pre_arming_plain_locks(armed_lockcheck):
+    plain = threading.Lock()  # constructed before arming (no _is_owned)
+    lockcheck.assert_held(plain, "Legacy._locked")
+    assert lockcheck.violations() == []
+
+
+def test_lockcheck_violations_bounded(armed_lockcheck):
+    lock = lockcheck.make_lock()
+    for _ in range(lockcheck.MAX_VIOLATIONS + 20):
+        lockcheck.assert_held(lock, "Hot._locked")
+    assert len(lockcheck.violations()) == lockcheck.MAX_VIOLATIONS
+
+
+def test_lockcheck_arm_from_env(monkeypatch):
+    lockcheck.disarm()
+    monkeypatch.delenv(lockcheck.ENV_VAR, raising=False)
+    assert lockcheck.arm_from_env() is None
+    assert not lockcheck.armed()
+    monkeypatch.setenv(lockcheck.ENV_VAR, "1")
+    assert lockcheck.arm_from_env() is True
+    assert lockcheck.armed()
+    lockcheck.disarm()
+
+
+def test_lockcheck_guarded_method_clean_when_called_properly(
+        armed_lockcheck):
+    """The shipped assert_held plants pass when the caller honours the
+    *_locked contract — FlightRecorder.add_instant under its own lock."""
+    from ont_tcrconsensus_tpu.obs.live import FlightRecorder
+    rec = FlightRecorder(max_events=8)
+    rec.add_instant("x", {})
+    assert lockcheck.violations() == []
+
+
+def test_lockcheck_catches_contract_breach(armed_lockcheck):
+    from ont_tcrconsensus_tpu.obs.live import FlightRecorder
+    rec = FlightRecorder(max_events=8)
+    rec._add_locked({"k": "breach"})  # deliberately without the lock
+    assert any("FlightRecorder._add_locked" in v
+               for v in lockcheck.violations())

@@ -11,13 +11,14 @@ from tools.graftlint.rules import (
     imports,
     jit_hygiene,
     lock_discipline,
+    lock_registry,
     obs_sites,
     recompile_hazard,
 )
 
 _MODULES = (jit_hygiene, exception_guard, chaos_sites, obs_sites,
             graph_sites, config_fields, imports, donation_use,
-            recompile_hazard, lock_discipline)
+            recompile_hazard, lock_discipline, lock_registry)
 
 CHECKS = tuple(m.check for m in _MODULES)
 

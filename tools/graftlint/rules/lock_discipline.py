@@ -8,13 +8,16 @@ from pool workers — each guards its state with one lock, and a mutation
 that skips it is a data race that only loses increments under load,
 never in a unit test.
 
-The ownership table is declarative and lives NEXT TO the class it
-protects: a ``LOCK_OWNERSHIP = {"ClassName.attr": "lock_attr"}`` dict
-literal anywhere in the scanned tree (obs/metrics.py, robustness/
-watchdog.py, pipeline/overlap.py ship one each); fixture trees declare
-their own, and with none in scope the rule no-ops — the same
-registry-in-the-scanned-set discipline as the chaos/obs/graph site
-rules.
+The ownership table is declarative: a ``LOCK_OWNERSHIP =
+{"ClassName.attr": "lock_attr"}`` dict literal anywhere in the scanned
+tree. The shipped tree consolidates every declaration into ONE registry
+(ont_tcrconsensus_tpu/robustness/locks.py — also the universe graftrace's
+lockset analysis proves over, and the lock set the runtime twin
+``TCR_LOCKCHECK=1`` asserts on); fixture trees declare their own, and
+with none in scope the rule no-ops — the same registry-in-the-scanned-set
+discipline as the chaos/obs/graph site rules. The companion
+``lock-registry`` sweep (lock_registry.py) keeps the table honest in
+both directions.
 
 Within a listed class, any *mutation* of ``self.<attr>`` — rebinding,
 augmented assignment, subscript store/delete, or a mutating method call

@@ -1,0 +1,164 @@
+"""lock-registry: the LOCK_OWNERSHIP table swept in both directions.
+
+The consolidated lock registry (ont_tcrconsensus_tpu/robustness/locks.py)
+is only trustworthy if it cannot rot. Same discipline as the chaos/obs
+site cross-checks, applied to lock ownership:
+
+- **declared-but-unused** (``lock-registry-unknown-attr``): every
+  ``"ClassName.attr": "lock_attr"`` entry — and every ``LOCK_EXEMPT``
+  key — must name a class that exists in the scanned tree, an attr that
+  class actually assigns on ``self``, and a lock attr that exists too.
+  A rename that orphans a declaration fails here instead of silently
+  un-protecting the attr (the discipline rule no-ops on unknown names).
+- **used-but-undeclared** (``lock-registry-undeclared-attr``): within a
+  class that appears in the registry, any ``self.x = <mutable
+  container>`` in ``__init__`` must be in LOCK_OWNERSHIP or LOCK_EXEMPT
+  (with its one-line reason). A new table added to a guarded class
+  cannot dodge the analyzers by just not being declared.
+
+Like lock-discipline, the rule keys off dict literals named
+``LOCK_OWNERSHIP`` / ``LOCK_EXEMPT`` anywhere in the scanned set, so
+fixture trees are self-contained and a scan with no registry no-ops.
+Only classes named in the registry are swept for undeclared containers —
+ordinary classes with plain dict/list state are not this rule's
+business.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.core import Finding, Project
+from tools.graftlint.rules.lock_discipline import _TABLE_NAME
+
+RULES = {
+    "lock-registry-unknown-attr": "LOCK_OWNERSHIP/LOCK_EXEMPT entry names "
+                                  "a class, attr, or lock that does not "
+                                  "exist in the scanned tree",
+    "lock-registry-undeclared-attr": "mutable container on a registered "
+                                     "class missing from both "
+                                     "LOCK_OWNERSHIP and LOCK_EXEMPT",
+}
+
+_EXEMPT_NAME = "LOCK_EXEMPT"
+
+#: constructor calls whose result is a shared-mutation hazard
+_CONTAINER_CTORS = {
+    "dict", "list", "set", "deque", "defaultdict", "OrderedDict", "Counter",
+}
+
+
+def _dict_literal_entries(project: Project, name: str):
+    """Yield (ctx, key_node, key, value) for every ``name = {...}`` literal."""
+    for ctx in project.files:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets
+            ) and isinstance(node.value, ast.Dict)):
+                continue
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    yield ctx, k, k.value, v
+
+
+def _is_container_value(node: ast.expr) -> bool:
+    if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                         ast.SetComp, ast.DictComp)):
+        return True
+    if isinstance(node, ast.Call):
+        fn = node.func
+        tail = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None)
+        return tail in _CONTAINER_CTORS
+    return False
+
+
+def _class_attrs(project: Project) -> dict[str, dict]:
+    """{class: {"attrs": {attr}, "containers": {attr: assign_node},
+    "ctx": FileCtx}} — every ``self.x = ...`` in each ClassDef body."""
+    out: dict[str, dict] = {}
+    for ctx in project.files:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            info = out.setdefault(
+                node.name, {"attrs": set(), "containers": {}, "ctx": ctx})
+            for method in node.body:
+                if not isinstance(method, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                    continue
+                in_init = method.name == "__init__"
+                for sub in ast.walk(method):
+                    if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                        continue
+                    targets = (sub.targets if isinstance(sub, ast.Assign)
+                               else [sub.target])
+                    for t in targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            info["attrs"].add(t.attr)
+                            if in_init and _is_container_value(sub.value):
+                                info["containers"].setdefault(t.attr, sub)
+    return out
+
+
+def check(project: Project) -> Iterator[Finding]:
+    owned_entries = list(_dict_literal_entries(project, _TABLE_NAME))
+    exempt_entries = list(_dict_literal_entries(project, _EXEMPT_NAME))
+    if not owned_entries:
+        return
+    classes = _class_attrs(project)
+
+    declared: dict[str, set[str]] = {}
+    # direction 1: every declared entry must resolve in the tree. The
+    # lock-attr check applies to LOCK_OWNERSHIP only — LOCK_EXEMPT
+    # values are prose reasons, not lock attrs.
+    for is_exempt, entries in ((False, owned_entries),
+                               (True, exempt_entries)):
+        for ctx, key_node, key, value in entries:
+            if "." not in key:
+                continue
+            cls, attr = key.rsplit(".", 1)
+            declared.setdefault(cls, set()).add(attr)
+            info = classes.get(cls)
+            if info is None:
+                yield Finding(
+                    ctx.path, key_node.lineno, key_node.col_offset,
+                    "lock-registry-unknown-attr",
+                    f"registry entry {key!r} names class {cls!r} which "
+                    "does not exist in the scanned tree — stale after a "
+                    "rename?")
+                continue
+            if attr not in info["attrs"]:
+                yield Finding(
+                    ctx.path, key_node.lineno, key_node.col_offset,
+                    "lock-registry-unknown-attr",
+                    f"registry entry {key!r}: {cls} never assigns "
+                    f"self.{attr} — stale after a rename?")
+            lock = (value.value if isinstance(value, ast.Constant)
+                    and isinstance(value.value, str) else None)
+            if not is_exempt and lock is not None \
+                    and lock not in info["attrs"]:
+                yield Finding(
+                    ctx.path, key_node.lineno, key_node.col_offset,
+                    "lock-registry-unknown-attr",
+                    f"registry entry {key!r} names lock {lock!r} which "
+                    f"{cls} never assigns")
+
+    # direction 2: every container on a registered class must be declared
+    for cls, attrs in declared.items():
+        info = classes.get(cls)
+        if info is None:
+            continue
+        for attr, node in sorted(info["containers"].items()):
+            if attr in attrs:
+                continue
+            yield Finding(
+                info["ctx"].path, node.lineno, node.col_offset,
+                "lock-registry-undeclared-attr",
+                f"{cls}.{attr} is a mutable container on a registered "
+                "class but is in neither LOCK_OWNERSHIP nor LOCK_EXEMPT "
+                "— declare its lock or exempt it with a reason")

@@ -1,0 +1,49 @@
+"""graftrace — whole-program static race & deadlock analyzer.
+
+graftlint's ``lock-discipline`` rule is lexical and per-method: it proves
+each declared registry mutation sits inside ``with self.<lock>:``. What
+it cannot see is the *whole-program* picture the serving/mesh stack now
+has — ~10 concurrent thread roots (overlap workers, the watchdog monitor
+and its async-exc cancel path, the live HTTP plane, the daemon loop,
+signal handlers) sharing the LOCK_OWNERSHIP state. graftrace promotes
+lock discipline from lint to proof, the way graftcheck did for the
+device graph:
+
+1. **Thread-root discovery** (:mod:`.callgraph`) — every
+   ``threading.Thread(target=)``, ``.submit()`` worker,
+   ``BaseHTTPRequestHandler`` ``do_*`` method, ``signal.signal`` hook,
+   plus the pipeline loop and daemon loop, becomes a named root of an
+   interprocedural call graph.
+2. **Lockset analysis** (:mod:`.locksets`) — Eraser-style (Savage et
+   al.): for every shared location in the consolidated LOCK_OWNERSHIP
+   registry (ont_tcrconsensus_tpu/robustness/locks.py) plus every
+   module-level mutable table, compute the set of locks held on each
+   access path from each root. A location written from ≥2 roots whose
+   write-lockset intersection is empty is ``race-unlocked-write``.
+   (Unlocked *reads* are tolerated by doctrine — the registries accept
+   torn reads for display — so the intersection runs over writes.)
+3. **Lock-order graph** — every acquire-while-holding edge across all
+   roots; any cycle is ``deadlock-order-inversion``.
+4. ``signal-unsafe-call`` — lock acquisition or blocking calls reachable
+   from a signal handler (the SIGUSR1 flush path is the known, baselined
+   case). ``blocking-under-lock`` — file I/O, sleeps, joins, device
+   gets, HTTP while holding a registry lock (a ``Condition.wait`` on the
+   held lock is exempt: wait releases it).
+
+Jax-free by construction (pure AST over :mod:`tools.graftlint.core`'s
+visitor core — the tier-1 run itself proves it imports nothing heavy).
+
+Exit codes (same contract as graftlint/graftcheck): 0 clean, 1 findings
+(or ``--expect`` drift in either direction), 2 internal/usage error —
+never a traceback. ``--json`` carries ``exit_code`` in the body.
+
+The committed expected list (``expected_findings.json``) pins the known
+findings with one-line justifications; tier-1 runs ``--expect`` so a new
+race/inversion/unsafe-call fails CI the day it is introduced.
+
+The dynamic twin lives in ont_tcrconsensus_tpu/robustness/lockcheck.py:
+``TCR_LOCKCHECK=1`` arms runtime owner-assertions on the same locks, so
+chaos e2es validate this static model against real interleavings.
+"""
+
+from tools.graftrace.cli import main  # noqa: F401

@@ -1,0 +1,103 @@
+"""Thread-root discovery: every entry point concurrency can start from.
+
+A *root* is a function some mechanism runs on its own thread (or
+asynchronously on an existing one): ``threading.Thread(target=)``,
+``.submit()`` worker functions, ``BaseHTTPRequestHandler`` ``do_*``
+handlers under a ``ThreadingHTTPServer``, ``signal.signal`` hooks, plus
+the two declared mains (the pipeline loop and the daemon loop). Lockset
+traversal (:mod:`.locksets`) starts from each root with an EMPTY held
+set — a worker never inherits its spawner's locks.
+
+Unresolvable targets (e.g. ``target=self._httpd.serve_forever`` — stdlib
+code) still appear in the inventory with ``func=None`` so the README's
+thread-root table and ``--json`` consumers see every spawn site, but
+nothing is traversed for them.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from tools.graftlint.astutil import dotted_name
+from tools.graftrace.index import FuncInfo, Index
+
+#: qname suffixes that are roots by declaration: the pipeline's per-run
+#: body (owns arming, the stage loop, every guard) and the daemon loop
+MAIN_ROOTS = (
+    ("pipeline.run._run_with_config", "pipeline-loop"),
+    ("serve.daemon.Daemon.serve_forever", "daemon-loop"),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Root:
+    name: str            # stable display name, e.g. "thread:Watchdog._monitor"
+    kind: str            # main | thread | pool | http | signal
+    func: str | None     # qname of the entry FuncInfo (None: external code)
+    path: str
+    line: int
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _is_http_handler(index: Index, cls: str) -> bool:
+    return any(b.rsplit(".", 1)[-1] == "BaseHTTPRequestHandler"
+               for b in index.class_bases.get(cls, ()))
+
+
+def discover_roots(index: Index) -> list[Root]:
+    roots: dict[tuple[str, str | None], Root] = {}
+
+    def add(kind: str, func: FuncInfo | None, path: str, line: int,
+            fallback: str = "?") -> None:
+        label = func.short if func is not None else f"<external {fallback}>"
+        root = Root(f"{kind}:{label}", kind,
+                    func.qname if func else None, path, line)
+        roots.setdefault((kind, root.name), root)
+
+    # declared mains
+    for suffix, label in MAIN_ROOTS:
+        for qname, fi in index.funcs.items():
+            if qname.endswith(suffix):
+                r = Root(f"main:{label}", "main", qname,
+                         fi.ctx.path, fi.node.lineno)
+                roots.setdefault(("main", r.name), r)
+
+    # http handler methods
+    for cls, (node, ctx, _mod) in index.classes.items():
+        if not _is_http_handler(index, cls):
+            continue
+        for method in node.body:
+            if isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and method.name.startswith("do_"):
+                fi = index.methods[(cls, method.name)]
+                add("http", fi, ctx.path, method.lineno)
+
+    # spawn sites: Thread(target=), .submit(fn), signal.signal(sig, fn)
+    for fi in index.funcs.values():
+        ltypes = index.local_types(fi)
+        for call in ast.walk(fi.node):
+            if not isinstance(call, ast.Call):
+                continue
+            full = fi.imports.resolve_call_target(call.func) or ""
+            if full.endswith("threading.Thread") or full == "Thread":
+                target = next((kw.value for kw in call.keywords
+                               if kw.arg == "target"), None)
+                if target is not None:
+                    hit = index.resolve_callable(target, fi, ltypes)
+                    add("thread", hit, fi.ctx.path, call.lineno,
+                        fallback=dotted_name(target) or "?")
+            elif isinstance(call.func, ast.Attribute) \
+                    and call.func.attr == "submit":
+                for arg in call.args:
+                    hit = index.resolve_callable(arg, fi, ltypes)
+                    if hit is not None:
+                        add("pool", hit, fi.ctx.path, call.lineno)
+            elif full.endswith("signal.signal") and len(call.args) >= 2:
+                hit = index.resolve_callable(call.args[1], fi, ltypes)
+                if hit is not None:
+                    add("signal", hit, fi.ctx.path, call.lineno)
+
+    return sorted(roots.values(), key=lambda r: (r.kind, r.name))

@@ -1,0 +1,128 @@
+"""graftrace CLI — graftcheck's ``--expect`` discipline over race findings.
+
+Exit codes: 0 clean, 1 findings or expected-list drift (either
+direction), 2 internal/usage error. Never a traceback.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.graftlint.core import Project, apply_baseline, load_baseline
+from tools.graftrace.callgraph import discover_roots
+from tools.graftrace.index import Index
+from tools.graftrace.locksets import Analyzer
+
+DEFAULT_EXPECT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "expected_findings.json")
+DEFAULT_PATHS = ["ont_tcrconsensus_tpu"]
+
+
+def analyze_paths(paths: list[str]):
+    """(findings, roots) for a tree — the library entry point."""
+    project = Project(paths)
+    index = Index(project)
+    roots = discover_roots(index)
+    analyzer = Analyzer(index, roots)
+    analyzer.run()
+    findings = sorted(analyzer.findings,
+                      key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, roots
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftrace",
+        description="whole-program static race & deadlock analysis "
+                    "(see tools/graftrace/__init__.py)",
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files or directories (default: {DEFAULT_PATHS})")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output (body carries exit_code)")
+    ap.add_argument("--roots", action="store_true", dest="roots_only",
+                    help="print the thread-root inventory and exit")
+    ap.add_argument("--expect", nargs="?", const=DEFAULT_EXPECT,
+                    help="compare findings against an expected list "
+                         "(default: the committed one); findings matching "
+                         "an entry pass, NEW findings and stale entries "
+                         "both fail")
+    ap.add_argument("--write-expect", metavar="FILE",
+                    help="write the current findings as the expected list "
+                         "(add a justification: to each entry before "
+                         "committing)")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as exc:  # argparse exits 2 on usage errors
+        return int(exc.code or 0)
+
+    try:
+        paths = args.paths or DEFAULT_PATHS
+        for path in paths:
+            if not os.path.exists(path):
+                print(f"graftrace: no such path: {path}", file=sys.stderr)
+                return 2
+
+        findings, roots = analyze_paths(paths)
+
+        if args.roots_only:
+            if args.as_json:
+                print(json.dumps({"roots": [r.to_dict() for r in roots]},
+                                 indent=2))
+            else:
+                for r in roots:
+                    print(f"{r.kind:7s} {r.name:45s} {r.path}:{r.line}")
+            return 0
+
+        if args.write_expect:
+            with open(args.write_expect, "w", encoding="utf-8") as fh:
+                json.dump({"findings": [
+                    {**f.to_dict(), "justification": ""} for f in findings
+                ]}, fh, indent=2)
+                fh.write("\n")
+            print(f"graftrace: wrote {len(findings)} finding(s) to "
+                  f"{args.write_expect}", file=sys.stderr)
+            return 0
+
+        baselined, stale = [], set()
+        if args.expect:
+            try:
+                known = load_baseline(args.expect)
+            except (OSError, ValueError) as exc:
+                print(f"graftrace: cannot read expected list "
+                      f"{args.expect}: {exc}", file=sys.stderr)
+                return 2
+            findings, baselined, stale = apply_baseline(findings, known)
+
+        rc = 1 if (findings or stale) else 0
+        if args.as_json:
+            print(json.dumps({
+                "findings": [f.to_dict() for f in findings],
+                "count": len(findings),
+                "baselined": [f.to_dict() for f in baselined],
+                "stale_expected": [
+                    {"path": p, "rule": r, "message": m}
+                    for p, r, m in sorted(stale)
+                ],
+                "roots": [r.to_dict() for r in roots],
+                "exit_code": rc,
+            }, indent=2))
+        else:
+            for finding in findings:
+                print(finding.format())
+            for finding in baselined:
+                print(f"{finding.format()} [expected]")
+            for p, r, m in sorted(stale):
+                print(f"graftrace: expected finding no longer reported "
+                      f"(fixed? remove it): {p}: {r} {m}", file=sys.stderr)
+            if findings:
+                print(f"graftrace: {len(findings)} new finding(s)",
+                      file=sys.stderr)
+        return rc
+    except Exception as exc:  # never-crash contract: no tracebacks
+        print(f"graftrace: internal error: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+        return 2

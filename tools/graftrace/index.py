@@ -1,0 +1,288 @@
+"""Whole-tree symbol index: the name-resolution substrate for graftrace.
+
+Built once per scan from graftlint's :class:`~tools.graftlint.core.Project`
+(same file collection, same parse error handling). Everything here is
+approximate-by-name — the tree has globally unique class names, so
+``(class, method)`` and ``module.function`` resolution is exact in
+practice while staying jax-free and import-free.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+
+from tools.graftlint.astutil import ImportMap, dotted_name
+from tools.graftlint.core import FileCtx, Project
+from tools.graftlint.rules.lock_discipline import ownership
+
+#: constructor tails that produce a lock (graftrace treats a Condition as
+#: its underlying lock — acquiring it acquires that lock)
+_LOCK_CTOR_TAILS = ("threading.Lock", "threading.RLock",
+                    "threading.Condition", "lockcheck.make_lock")
+
+#: constructor tails that produce a shared-mutation-hazard container
+_CONTAINER_CTORS = {
+    "dict", "list", "set", "deque", "defaultdict", "OrderedDict", "Counter",
+}
+
+
+def module_name(path: str) -> str:
+    """Dotted module path for a scanned file ('pkg/obs/live.py' ->
+    'pkg.obs.live'); scans run from the repo root so relative paths are
+    package-rooted."""
+    p = path.replace(os.sep, "/").lstrip("./")
+    if p.endswith(".py"):
+        p = p[:-3]
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function or method definition, locatable and resolvable."""
+
+    qname: str           # "pkg.obs.live.FlightRecorder.add_span"
+    module: str
+    cls: str | None
+    name: str
+    node: ast.FunctionDef
+    ctx: FileCtx
+    imports: ImportMap
+
+    @property
+    def short(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else \
+            f"{self.module.rsplit('.', 1)[-1]}.{self.name}"
+
+
+def _is_lock_ctor(call: ast.expr, imports: ImportMap) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    full = imports.resolve_call_target(call.func) or ""
+    return any(full == t or full.endswith("." + t) for t in _LOCK_CTOR_TAILS)
+
+
+def _is_container_value(node: ast.expr) -> bool:
+    if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                         ast.SetComp, ast.DictComp)):
+        return True
+    if isinstance(node, ast.Call):
+        fn = node.func
+        tail = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None)
+        return tail in _CONTAINER_CTORS
+    return False
+
+
+class Index:
+    """Symbols of the scanned tree, keyed for interprocedural traversal."""
+
+    def __init__(self, project: Project):
+        #: {class: {attr: lock_attr}} — the LOCK_OWNERSHIP universe
+        self.ownership = ownership(project)
+        self.funcs: dict[str, FuncInfo] = {}
+        self.module_funcs: dict[tuple[str, str], FuncInfo] = {}
+        self.methods: dict[tuple[str, str], FuncInfo] = {}
+        #: class name -> (node, ctx, module, base dotted-name tails)
+        self.classes: dict[str, tuple[ast.ClassDef, FileCtx, str]] = {}
+        self.class_bases: dict[str, list[str]] = {}
+        #: (class, attr) -> class of the object stored there
+        self.attr_types: dict[tuple[str, str], str] = {}
+        #: (module, global name) -> class (from AnnAssign or ctor assign)
+        self.global_types: dict[tuple[str, str], str] = {}
+        #: class -> lock attr names on self
+        self.class_locks: dict[str, set[str]] = {}
+        #: (class, condition attr) -> underlying lock attr
+        self.condition_map: dict[tuple[str, str], str] = {}
+        #: module-level locks: (module, name)
+        self.module_locks: set[tuple[str, str]] = set()
+        #: module-level mutable containers: (module, name) -> (ctx, node)
+        self.module_tables: dict[tuple[str, str], tuple[FileCtx, ast.AST]] = {}
+        self.imports: dict[str, ImportMap] = {}
+
+        for ctx in project.files:
+            self.imports[ctx.path] = ImportMap(ctx.tree)
+
+        # pass A: classes, methods, module functions
+        for ctx in project.files:
+            mod = module_name(ctx.path)
+            imp = self.imports[ctx.path]
+            for stmt in ctx.tree.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fi = FuncInfo(f"{mod}.{stmt.name}", mod, None, stmt.name,
+                                  stmt, ctx, imp)
+                    self.funcs[fi.qname] = fi
+                    self.module_funcs[(mod, stmt.name)] = fi
+                elif isinstance(stmt, ast.ClassDef):
+                    self.classes[stmt.name] = (stmt, ctx, mod)
+                    self.class_bases[stmt.name] = [
+                        d for d in (dotted_name(b) for b in stmt.bases)
+                        if d is not None
+                    ]
+                    for sub in stmt.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            fi = FuncInfo(f"{mod}.{stmt.name}.{sub.name}",
+                                          mod, stmt.name, sub.name,
+                                          sub, ctx, imp)
+                            self.funcs[fi.qname] = fi
+                            self.methods[(stmt.name, sub.name)] = fi
+
+        # registry lock attrs exist even where the ctor is indirect
+        for cls, attrs in self.ownership.items():
+            for lock in attrs.values():
+                self.class_locks.setdefault(cls, set()).add(lock)
+
+        # pass B: types, locks, module tables
+        for ctx in project.files:
+            mod = module_name(ctx.path)
+            imp = self.imports[ctx.path]
+            for stmt in ctx.tree.body:
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name):
+                    name = stmt.targets[0].id
+                    if _is_lock_ctor(stmt.value, imp):
+                        self.module_locks.add((mod, name))
+                    elif _is_container_value(stmt.value):
+                        self.module_tables[(mod, name)] = (ctx, stmt)
+                    else:
+                        c = self._class_of_ctor(stmt.value, imp)
+                        if c is not None:
+                            self.global_types[(mod, name)] = c
+                elif isinstance(stmt, ast.AnnAssign) \
+                        and isinstance(stmt.target, ast.Name):
+                    c = self._class_in_annotation(stmt.annotation)
+                    if c is not None:
+                        self.global_types[(mod, stmt.target.id)] = c
+                    elif stmt.value is not None \
+                            and _is_container_value(stmt.value):
+                        self.module_tables[(mod, stmt.target.id)] = (ctx, stmt)
+
+        for cls, (node, ctx, mod) in self.classes.items():
+            imp = self.imports[ctx.path]
+            for method in node.body:
+                if not isinstance(method, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                    continue
+                for sub in ast.walk(method):
+                    if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                        continue
+                    targets = (sub.targets if isinstance(sub, ast.Assign)
+                               else [sub.target])
+                    for t in targets:
+                        if not (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            continue
+                        value = sub.value
+                        if value is None:
+                            continue
+                        if _is_lock_ctor(value, imp):
+                            self.class_locks.setdefault(cls, set()).add(t.attr)
+                            if isinstance(value, ast.Call) and value.args:
+                                base = value.args[0]
+                                if (isinstance(base, ast.Attribute)
+                                        and isinstance(base.value, ast.Name)
+                                        and base.value.id == "self"
+                                        and "Condition" in (
+                                            dotted_name(value.func) or "")):
+                                    self.condition_map[(cls, t.attr)] = \
+                                        base.attr
+                        else:
+                            c = self._class_of_ctor(value, imp)
+                            if c is not None:
+                                self.attr_types[(cls, t.attr)] = c
+
+    # --- resolution helpers -------------------------------------------------
+
+    def _class_of_ctor(self, value: ast.expr, imp: ImportMap) -> str | None:
+        if not isinstance(value, ast.Call):
+            return None
+        full = imp.resolve_call_target(value.func)
+        if full is None:
+            return None
+        tail = full.rsplit(".", 1)[-1]
+        return tail if tail in self.classes else None
+
+    def _class_in_annotation(self, ann: ast.expr | None) -> str | None:
+        """First known class named anywhere in a type annotation
+        (``Watchdog | None`` and ``"Watchdog | None"`` both resolve)."""
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return None
+        for node in ast.walk(ann):
+            name = None
+            if isinstance(node, ast.Name):
+                name = node.id
+            elif isinstance(node, ast.Attribute):
+                name = node.attr
+            if name in self.classes:
+                return name
+        return None
+
+    def local_types(self, fi: FuncInfo) -> dict[str, str]:
+        """{var: class} for ``v = C(...)`` / ``v = self.attr`` /
+        ``v = MODULE_GLOBAL`` in one function body (no flow sensitivity)."""
+        out: dict[str, str] = {}
+        for sub in ast.walk(fi.node):
+            if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Name)):
+                continue
+            var = sub.targets[0].id
+            value = sub.value
+            c = self._class_of_ctor(value, fi.imports)
+            if c is None and isinstance(value, ast.Attribute) \
+                    and isinstance(value.value, ast.Name) \
+                    and value.value.id == "self" and fi.cls:
+                c = self.attr_types.get((fi.cls, value.attr))
+            if c is None and isinstance(value, ast.Name):
+                c = self.global_types.get((fi.module, value.id))
+            if c is not None:
+                out[var] = c
+        return out
+
+    def resolve_callable(self, expr: ast.expr, fi: FuncInfo,
+                         ltypes: dict[str, str]) -> FuncInfo | None:
+        """The FuncInfo an expression refers to, or None: ``self.meth``,
+        ``self.attr.meth``, ``var.meth``, ``name``, ``mod.func``."""
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name):
+                if base.id == "self" and fi.cls:
+                    hit = self.methods.get((fi.cls, expr.attr))
+                    if hit is not None:
+                        return hit
+                    via = self.attr_types.get((fi.cls, expr.attr))
+                    if via is not None:
+                        return None  # self.attr is an object, not callable
+                cls = ltypes.get(base.id) or \
+                    self.global_types.get((fi.module, base.id))
+                if cls is not None:
+                    return self.methods.get((cls, expr.attr))
+            elif (isinstance(base, ast.Attribute)
+                  and isinstance(base.value, ast.Name)
+                  and base.value.id == "self" and fi.cls):
+                cls = self.attr_types.get((fi.cls, base.attr))
+                if cls is not None:
+                    return self.methods.get((cls, expr.attr))
+            full = fi.imports.resolve_call_target(expr)
+            if full is not None and not full.startswith("self."):
+                hit = self.funcs.get(full)
+                if hit is not None:
+                    return hit
+            return None
+        if isinstance(expr, ast.Name):
+            hit = self.module_funcs.get((fi.module, expr.id))
+            if hit is not None:
+                return hit
+            full = fi.imports.from_imports.get(expr.id)
+            if full is not None:
+                return self.funcs.get(full)
+        return None

@@ -1,0 +1,445 @@
+"""Eraser-style lockset analysis + lock-order graph over the call graph.
+
+From each discovered root (:mod:`.callgraph`) the analyzer walks the
+interprocedural call graph with a *held lockset*: ``with self._lock:``
+adds ``"Class._lock"`` (a Condition adds its underlying lock), a module
+lock adds ``"module:name"``, and calls into other objects' methods carry
+the set along — so ``JobQueue.submit`` calling the armed metrics wrapper
+observes ``{JobQueue._lock, MetricsRegistry._lock}`` inside the
+registry, which is exactly how the lock-order edge is found.
+
+Recorded along the way:
+
+- **accesses** to every LOCK_OWNERSHIP location (``self.attr`` on a
+  registered class) and every module-level mutable table — root, held
+  set, location, read/write;
+- **order edges**: acquiring L while holding H adds H→L with a witness
+  site;
+- **blocking sites**: file I/O / sleep / join / result / device get /
+  subprocess / HTTP while holding a lock — and *any* lock acquisition or
+  blocking call when the root is a signal handler.
+
+Findings (rule ids are the baseline contract):
+
+- ``race-unlocked-write``: a location with accesses from ≥2 roots and ≥1
+  write whose write-lockset intersection is empty. Reads don't shrink
+  the lockset — the registries tolerate torn reads by doctrine — but
+  they do count toward the ≥2-root reach.
+- ``deadlock-order-inversion``: a cycle in the order graph.
+- ``blocking-under-lock`` / ``signal-unsafe-call``: per site.
+
+Boundaries, matching graftlint's lock-discipline rule: nested ``def``s
+and lambdas do not inherit the held set (they may run later on another
+thread); ``Thread(target=...)`` / ``.submit(fn)`` arguments are separate
+roots and are not traversed at the spawn site. Module-global *rebinds*
+(``_ACTIVE = wd``) are exempt — atomic-reference hand-off is the
+documented arming discipline; only container mutations are tracked.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Finding
+from tools.graftrace.callgraph import Root
+from tools.graftrace.index import FuncInfo, Index
+
+_MUTATING_METHODS = {
+    "append", "add", "update", "setdefault", "pop", "popitem", "clear",
+    "remove", "discard", "extend", "insert", "appendleft", "popleft",
+    "__setitem__",
+}
+
+#: fully-resolved call targets that block (or do I/O)
+_BLOCKING_CALLS = {
+    "open", "gzip.open", "time.sleep", "urllib.request.urlopen",
+    "subprocess.run", "subprocess.check_call", "subprocess.check_output",
+    "subprocess.Popen", "socket.create_connection", "requests.get",
+    "requests.post", "jax.device_get", "os.replace", "json.dump",
+    "shutil.copyfile",
+}
+
+#: method names that block on any receiver (join/result only bare or with
+#: a timeout — ``", ".join(parts)`` is string formatting, not blocking)
+_BLOCKING_METHODS = {"result", "block_until_ready", "serve_forever",
+                     "acquire", "wait"}
+
+
+class Access:
+    __slots__ = ("location", "root", "held", "path", "line", "write")
+
+    def __init__(self, location, root, held, path, line, write):
+        self.location = location
+        self.root = root
+        self.held = held
+        self.path = path
+        self.line = line
+        self.write = write
+
+    def key(self):
+        return (self.location, self.root, self.held, self.path, self.line,
+                self.write)
+
+
+class Analyzer:
+    """One whole-tree analysis: traverse every root, then report."""
+
+    def __init__(self, index: Index, roots: list[Root]):
+        self.index = index
+        self.roots = roots
+        self.accesses: dict[str, dict[tuple, Access]] = {}
+        #: (from_lock, to_lock) -> (path, line) first witness
+        self.order_edges: dict[tuple[str, str], tuple[str, int]] = {}
+        self.findings: list[Finding] = []
+        self._finding_keys: set[tuple] = set()
+        self._memo: set[tuple] = set()
+
+    # --- recording ----------------------------------------------------------
+
+    def _record_access(self, location, root, held, path, line, write):
+        acc = Access(location, root.name, frozenset(held), path, line, write)
+        self.accesses.setdefault(location, {})[acc.key()] = acc
+
+    def _add_finding(self, path, line, col, rule, message):
+        key = (path, rule, message)
+        if key not in self._finding_keys:
+            self._finding_keys.add(key)
+            self.findings.append(Finding(path, line, col, rule, message))
+
+    # --- traversal ----------------------------------------------------------
+
+    def run(self) -> None:
+        for root in self.roots:
+            if root.func is None:
+                continue
+            fi = self.index.funcs.get(root.func)
+            if fi is not None:
+                self._memo = set()
+                is_sig = root.kind == "signal"  # graftlint: disable=chaos-unknown-kind
+                self._visit_func(fi, frozenset(), root, signal_ctx=is_sig)
+        self._report_races()
+        self._report_order_cycles()
+
+    def _visit_func(self, fi: FuncInfo, held: frozenset, root: Root,
+                    signal_ctx: bool) -> None:
+        key = (fi.qname, held)
+        if key in self._memo:
+            return
+        self._memo.add(key)
+        walker = _FuncWalker(self, fi, set(held), root, signal_ctx)
+        walker.walk(fi.node.body)
+
+    # --- reporting ----------------------------------------------------------
+
+    def _report_races(self) -> None:
+        for location in sorted(self.accesses):
+            accs = list(self.accesses[location].values())
+            roots = sorted({a.root for a in accs})
+            writes = [a for a in accs if a.write]
+            if len(roots) < 2 or not writes:
+                continue
+            common = frozenset.intersection(*[a.held for a in writes])
+            if common:
+                continue
+            anchor = next((w for w in writes if not w.held), writes[0])
+            self._add_finding(
+                anchor.path, anchor.line, 0, "race-unlocked-write",
+                f"{location} is written with an empty lockset intersection "
+                f"across roots [{', '.join(roots)}] — an Eraser-style data "
+                "race; guard every write with one common lock")
+
+    def _report_order_cycles(self) -> None:
+        graph: dict[str, set[str]] = {}
+        for (a, b) in self.order_edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        # iterative Tarjan SCC
+        idx, low, stack, on_stack = {}, {}, [], set()
+        sccs, counter = [], [0]
+
+        def strongconnect(v0):
+            work = [(v0, iter(sorted(graph[v0])))]
+            idx[v0] = low[v0] = counter[0]
+            counter[0] += 1
+            stack.append(v0)
+            on_stack.add(v0)
+            while work:
+                v, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in idx:
+                        idx[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(sorted(graph[w]))))
+                        advanced = True
+                        break
+                    if w in on_stack:
+                        low[v] = min(low[v], idx[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    pv = work[-1][0]
+                    low[pv] = min(low[pv], low[v])
+                if low[v] == idx[v]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == v:
+                            break
+                    sccs.append(scc)
+
+        for v in sorted(graph):
+            if v not in idx:
+                strongconnect(v)
+
+        for scc in sccs:
+            if len(scc) < 2:
+                continue
+            members = sorted(scc)
+            edges = sorted(
+                (a, b) for (a, b) in self.order_edges
+                if a in scc and b in scc
+            )
+            witness = [
+                f"{a}->{b} at {self.order_edges[(a, b)][0]}:"
+                f"{self.order_edges[(a, b)][1]}" for a, b in edges
+            ]
+            path, line = self.order_edges[edges[0]]
+            self._add_finding(
+                path, line, 0, "deadlock-order-inversion",
+                f"lock-order cycle among [{', '.join(members)}]: "
+                f"{'; '.join(witness)} — two threads taking these in "
+                "opposite orders deadlock")
+
+
+class _FuncWalker:
+    """Statement walker for one function body under one held set."""
+
+    def __init__(self, analyzer: Analyzer, fi: FuncInfo, held: set,
+                 root: Root, signal_ctx: bool):
+        self.an = analyzer
+        self.ix = analyzer.index
+        self.fi = fi
+        self.held = held
+        self.root = root
+        self.signal_ctx = signal_ctx
+        self.ltypes = self.ix.local_types(fi)
+        self.owned = self.ix.ownership.get(fi.cls or "", {})
+
+    # --- lock identity ------------------------------------------------------
+
+    def _lock_id(self, expr: ast.expr) -> str | None:
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and self.fi.cls):
+            cls = self.fi.cls
+            under = self.ix.condition_map.get((cls, expr.attr))
+            if under is not None:
+                return f"{cls}.{under}"
+            if expr.attr in self.ix.class_locks.get(cls, ()):
+                return f"{cls}.{expr.attr}"
+            return None
+        if isinstance(expr, ast.Name) \
+                and (self.fi.module, expr.id) in self.ix.module_locks:
+            return f"{self.fi.module}:{expr.id}"
+        return None
+
+    def _acquire(self, lock: str, node: ast.AST) -> set:
+        if self.signal_ctx:
+            self.an._add_finding(
+                self.fi.ctx.path, node.lineno, node.col_offset,
+                "signal-unsafe-call",
+                f"{self.fi.short} acquires {lock} in signal-handler "
+                f"context ({self.root.name}) — deadlocks if the "
+                "interrupted frame holds it")
+        added = set()
+        if lock not in self.held:
+            for h in sorted(self.held):
+                self.an.order_edges.setdefault(
+                    (h, lock), (self.fi.ctx.path, node.lineno))
+            self.held.add(lock)
+            added.add(lock)
+        return added
+
+    # --- statements ---------------------------------------------------------
+
+    def walk(self, body: list[ast.stmt]) -> None:
+        for stmt in body:
+            self.visit(stmt)
+
+    def visit(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs run later, possibly on another thread
+        if isinstance(stmt, ast.With):
+            added: set = set()
+            for item in stmt.items:
+                lock = self._lock_id(item.context_expr)
+                if lock is not None:
+                    added |= self._acquire(lock, item.context_expr)
+                self._scan_expr(item.context_expr)
+            self.walk(stmt.body)
+            self.held -= added
+            return
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for t in targets:
+                self._check_store(t, stmt)
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self._check_store(t, stmt)
+        self._scan_expr(stmt)
+        for field in ("body", "orelse", "finalbody"):
+            for sub in getattr(stmt, field, ()):
+                self.visit(sub)
+        for handler in getattr(stmt, "handlers", ()):
+            for sub in handler.body:
+                self.visit(sub)
+
+    def _check_store(self, target: ast.expr, stmt: ast.stmt) -> None:
+        subscripted = False
+        node = target
+        while isinstance(node, ast.Subscript):
+            node = node.value
+            subscripted = True
+        attr = None
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            attr = node.attr
+        if attr is not None and attr in self.owned:
+            self._record(f"{self.fi.cls}.{attr}", stmt, write=True)
+            return
+        # module table: NAME[k] = v / NAME += / del NAME[k] mutate; a
+        # plain NAME = v rebind is the exempt atomic-reference hand-off
+        if isinstance(node, ast.Name):
+            key = (self.fi.module, node.id)
+            if key in self.ix.module_tables and (
+                    subscripted or isinstance(stmt, ast.AugAssign)):
+                self._record(f"{self.fi.module}:{node.id}", stmt, write=True)
+
+    def _record(self, location: str, node: ast.AST, write: bool) -> None:
+        self.an._record_access(location, self.root, self.held,
+                               self.fi.ctx.path, node.lineno, write)
+
+    # --- expressions --------------------------------------------------------
+
+    def _scan_expr(self, node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.stmt, ast.excepthandler, ast.Lambda)):
+                continue
+            if isinstance(child, ast.Call):
+                self._handle_call(child)
+            elif isinstance(child, ast.Attribute) \
+                    and isinstance(child.ctx, ast.Load) \
+                    and isinstance(child.value, ast.Name) \
+                    and child.value.id == "self" \
+                    and child.attr in self.owned:
+                self._record(f"{self.fi.cls}.{child.attr}", child,
+                             write=False)
+            elif isinstance(child, ast.Name) \
+                    and isinstance(child.ctx, ast.Load) \
+                    and (self.fi.module, child.id) in self.ix.module_tables:
+                self._record(f"{self.fi.module}:{child.id}", child,
+                             write=False)
+            self._scan_expr(child)
+
+    def _handle_call(self, call: ast.Call) -> None:
+        full = self.fi.imports.resolve_call_target(call.func) or ""
+        attr = call.func.attr if isinstance(call.func, ast.Attribute) \
+            else None
+        is_thread_ctor = full.endswith("threading.Thread") or full == "Thread"
+
+        # mutating method on a registered self.attr or a module table
+        if attr in _MUTATING_METHODS and isinstance(call.func,
+                                                    ast.Attribute):
+            recv = call.func.value
+            base = recv
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            if (isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "self"
+                    and base.attr in self.owned):
+                self._record(f"{self.fi.cls}.{base.attr}", call, write=True)
+            elif isinstance(base, ast.Name) and \
+                    (self.fi.module, base.id) in self.ix.module_tables:
+                self._record(f"{self.fi.module}:{base.id}", call, write=True)
+
+        self._check_blocking(call, full, attr)
+
+        if is_thread_ctor:
+            return  # target= is a separate root; the ctor runs nothing
+        spawned: set[int] = set()
+        if attr == "submit":
+            # a pool submit iff some arg is a known callable (it becomes a
+            # worker root); JobQueue.submit and friends take data args and
+            # are ordinary synchronous calls
+            spawned = {
+                id(a) for a in call.args
+                if self.ix.resolve_callable(a, self.fi, self.ltypes)
+                is not None
+            }
+        if not spawned:
+            callee = self.ix.resolve_callable(call.func, self.fi,
+                                              self.ltypes)
+            if callee is not None:
+                self.an._visit_func(callee, frozenset(self.held),
+                                    self.root, self.signal_ctx)
+        # callbacks handed to other code run without our held set later;
+        # traverse them with an EMPTY set so their own discipline is still
+        # checked under this root (e.g. on_done=self._note_done)
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if id(arg) in spawned:
+                continue
+            cb = self.ix.resolve_callable(arg, self.fi, self.ltypes)
+            if cb is not None and not isinstance(arg, ast.Call):
+                self.an._visit_func(cb, frozenset(), self.root,
+                                    self.signal_ctx)
+
+    def _check_blocking(self, call: ast.Call, full: str,
+                        attr: str | None) -> None:
+        desc = None
+        if full in _BLOCKING_CALLS and not full.startswith("self."):
+            desc = f"{full}()"
+        elif attr == "join":
+            # Thread.join() blocks; ", ".join(parts) does not — require a
+            # bare call or a receiver that is not a string-ish constant
+            recv = call.func.value
+            if not call.args and not isinstance(recv, ast.Constant):
+                desc = ".join()"
+        elif attr in _BLOCKING_METHODS:
+            if attr == "wait":
+                # Condition.wait on a HELD lock releases it while waiting:
+                # that is the correct pattern, not a block-under-lock
+                recv = call.func.value
+                if (isinstance(recv, ast.Attribute)
+                        and isinstance(recv.value, ast.Name)
+                        and recv.value.id == "self" and self.fi.cls):
+                    under = self.ix.condition_map.get(
+                        (self.fi.cls, recv.attr))
+                    if under is not None \
+                            and f"{self.fi.cls}.{under}" in self.held:
+                        return
+            desc = f".{attr}()"
+        if desc is None:
+            return
+        if self.signal_ctx:
+            self.an._add_finding(
+                self.fi.ctx.path, call.lineno, call.col_offset,
+                "signal-unsafe-call",
+                f"{self.fi.short} calls blocking {desc} in signal-handler "
+                f"context ({self.root.name})")
+        if self.held:
+            locks = ", ".join(sorted(self.held))
+            self.an._add_finding(
+                self.fi.ctx.path, call.lineno, call.col_offset,
+                "blocking-under-lock",
+                f"{self.fi.short} calls blocking {desc} while holding "
+                f"[{locks}] — stalls every thread contending for the lock")
