@@ -164,7 +164,8 @@ def run_once(root: str, live_port: int | None = None, mesh_shape=None):
     return results, dt, cfg
 
 
-def run_daemon_bench(root: str, args) -> tuple[float, float, dict, object]:
+def run_daemon_bench(root: str, args,
+                     mesh_shape=None) -> tuple[float, float, dict, object]:
     """The --daemon arm: cold-start vs steady-state through the warm-serving
     daemon (serve/daemon.py) instead of two bare run_with_config calls.
 
@@ -190,9 +191,18 @@ def run_daemon_bench(root: str, args) -> tuple[float, float, dict, object]:
         "read_batch_size": 1024,
         "delete_tmp_files": False,
     }
+    workers = 1
+    if mesh_shape:
+        # --mesh + --daemon: the shape pins every job's slice through the
+        # serve-plane allocator (serve/slices.py sizes the lease by the
+        # axis product), so the bench jobs really run sharded — this used
+        # to be silently ignored
+        template["mesh_shape"] = dict(mesh_shape)
+        workers = 2
     t0 = time.time()
     daemon = Daemon(template, port=args.live_port or 0,
-                    state_dir=os.path.join(root, "serve_state"))
+                    state_dir=os.path.join(root, "serve_state"),
+                    workers=workers)
     loop = threading.Thread(target=daemon.serve_forever,
                             name="bench-daemon", daemon=True)
     loop.start()
@@ -355,8 +365,9 @@ def parse_args(argv=None):
         "via XLA_FLAGS --xla_force_host_platform_device_count (virtual "
         "CPU devices — relative scaling only). The mesh config lands as "
         "'mesh_config' in the JSON line and the ledger entry, so per-"
-        "mesh scaling history gates only against its own shape. "
-        "Ignored by --daemon.",
+        "mesh scaling history gates only against its own shape. With "
+        "--daemon the shape is threaded into the serve template and pins "
+        "each bench job's slice through the serve-plane slice allocator.",
     )
     ap.add_argument("--gate-threshold", type=float, default=0.15)
     ap.add_argument("--gate-mad-k", type=float, default=4.0)
@@ -382,22 +393,19 @@ def main(argv=None) -> int:
     mesh_shape = None
     if args.mesh:
         mesh_shape = parse_mesh_spec(args.mesh)
-        if args.daemon:
-            print("bench: --daemon ignores --mesh", file=sys.stderr)
-            mesh_shape = None
-        else:
-            # the device-count force must land in the environment BEFORE
-            # any jax import in this process (the flag is read at backend
-            # init); harmless on a real multi-chip backend, and exactly
-            # how tests/conftest.py builds its virtual 8-device mesh
-            total = 1
-            for n in mesh_shape.values():
-                total *= n
-            flags = os.environ.get("XLA_FLAGS", "")
-            os.environ["XLA_FLAGS"] = (
-                f"{flags} --xla_force_host_platform_device_count={total}"
-            ).strip()
-            print(f"bench: sharded arm, mesh {mesh_shape}", file=sys.stderr)
+        # the device-count force must land in the environment BEFORE
+        # any jax import in this process (the flag is read at backend
+        # init); harmless on a real multi-chip backend, and exactly
+        # how tests/conftest.py builds its virtual 8-device mesh
+        total = 1
+        for n in mesh_shape.values():
+            total *= n
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={total}"
+        ).strip()
+        arm = "daemon (slice-allocator)" if args.daemon else "sharded"
+        print(f"bench: {arm} arm, mesh {mesh_shape}", file=sys.stderr)
     # Probe FIRST so a dead backend yields a diagnosable artifact (rc=0,
     # "tpu_unavailable") instead of a stack trace after minutes of setup.
     # BENCH_FORCE_CPU=1 is a dev-only escape hatch for relative timing when
@@ -454,7 +462,8 @@ def main(argv=None) -> int:
             from ont_tcrconsensus_tpu.pipeline.config import RunConfig
             from ont_tcrconsensus_tpu.pipeline.run import _read_counts_csv
 
-            warm_dt, dt, job2, daemon = run_daemon_bench(root, args)
+            warm_dt, dt, job2, daemon = run_daemon_bench(
+                root, args, mesh_shape=mesh_shape)
             results = {"barcode01": _read_counts_csv(os.path.join(
                 root, "fastq_pass", "nano_tcr", "barcode01", "counts",
                 "umi_consensus_counts.csv"))}
@@ -465,6 +474,7 @@ def main(argv=None) -> int:
                 "min_reads_per_cluster": 4,
                 "read_batch_size": 1024,
                 "delete_tmp_files": False,
+                **({"mesh_shape": dict(mesh_shape)} if mesh_shape else {}),
             })
             pre = daemon.prewarm_report or {}
             daemon_extra = {

@@ -106,6 +106,14 @@ OBS_SITES = frozenset({
     "serve.first_stage_s",
     "serve.job",
     "serve.drain",
+    # --- slice-packed multi-tenant serving (serve/slices.py +
+    # serve/daemon.py runner pool): resident-job live gauge via
+    # metrics.gauge_set and slice assign/release/quarantine flight-ring
+    # instants via live.ring_event — the per-slice tenant-occupancy and
+    # quarantine label tables ride their own families,
+    # tcr_mesh_slice_busy{tenant=} / tcr_slice_quarantined_total) ---
+    "serve.resident_jobs",
+    "serve.slice",
     # --- device data-plane ledger (obs/transfers.py: transfer plants at
     # the device boundary, donation-audit and HBM-reconcile sample
     # counters via metrics.counter_add) ---

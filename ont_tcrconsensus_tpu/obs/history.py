@@ -66,6 +66,7 @@ FINGERPRINT_EXCLUDED_KEYS = frozenset({
     "compile_cache_dir",
     "serve_queue_max",
     "serve_prewarm",
+    "serve_workers",
 })
 
 #: MAD -> sigma-equivalent scale for normally-distributed noise

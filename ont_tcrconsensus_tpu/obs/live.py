@@ -58,7 +58,7 @@ from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ont_tcrconsensus_tpu.obs import history, metrics, trace
-from ont_tcrconsensus_tpu.robustness import lockcheck, watchdog
+from ont_tcrconsensus_tpu.robustness import jobscope, lockcheck, watchdog
 
 #: flight-recorder ring capacity. Sized for "the last few minutes of a
 #: wedged run": heartbeats are per-batch/per-chunk (not per-read), so 512
@@ -639,8 +639,16 @@ def set_jobs_controller(ctl) -> None:
 def set_node_start_hook(fn) -> None:
     """Arm (or with None, disarm) a graph-node-start observer. The serve
     daemon uses this as its dispatch-to-first-stage latency tap: armed at
-    job dequeue, self-disarming at the first node."""
+    job dequeue, self-disarming at the first node.
+
+    Under a jobscope (the slice-packed runner pool) the hook binds
+    thread-locally — each resident tenant job taps its OWN first node;
+    stored as a ``(fn,)`` 1-tuple so the in-scope self-disarm tombstones
+    instead of falling back to a neighbor's hook."""
     global _NODE_START_HOOK
+    if jobscope.active():
+        jobscope.set("node_start_hook", (fn,))
+        return
     _NODE_START_HOOK = fn
 
 
@@ -652,7 +660,15 @@ def ring_event(site: str, args: dict | None = None) -> None:
 
 
 def set_flush_path(path: str) -> None:
-    """Point crash/SIGUSR1 flushes at the run's output tree."""
+    """Point crash/SIGUSR1 flushes at the run's output tree.
+
+    Under a jobscope this is a no-op on the shared ring: the flight
+    recorder is ONE process-wide black box owned by the daemon, and two
+    resident tenant jobs re-pointing it at their own output trees would
+    race — the daemon's state-dir path stays authoritative."""
+    if jobscope.active():
+        jobscope.set("flush_path", path)
+        return
     ring = _RING
     if ring is not None:
         ring.set_flush_path(path)
@@ -703,7 +719,8 @@ def progress_node_start(name: str, units: int | None = None) -> None:
     tracker = _PROGRESS
     if tracker is not None:
         tracker.node_start(name, units)
-    hook = _NODE_START_HOOK
+    entry = jobscope.get("node_start_hook")
+    hook = entry[0] if entry is not None else _NODE_START_HOOK
     if hook is not None:
         try:
             hook(name)

@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import time
 
-from ont_tcrconsensus_tpu.robustness import lockcheck
+from ont_tcrconsensus_tpu.robustness import jobscope, lockcheck
 
 
 class MetricsRegistry:
@@ -55,6 +55,13 @@ class MetricsRegistry:
         # chaos/fault site ("mesh.device_lost") -> count of degraded-mesh
         # re-executions it caused; label dimension, not an OBS_SITES site
         self.mesh_degraded: dict[str, float] = {}
+        # mesh slice ("cpu:0") -> resident tenant job id ("" when idle);
+        # written by the serve-plane slice allocator (serve/slices.py) so
+        # /metrics shows who owns what — label dimension, not a site
+        self.slice_tenants: dict[str, str] = {}
+        # mesh slice -> quarantine count (device_lost contained to that
+        # slice); label dimension, not an OBS_SITES site
+        self.slice_quarantined: dict[str, float] = {}
         # site -> [count, sum, min, max]
         self.hists: dict[str, list[float]] = {}
         # name -> [seconds, calls]
@@ -131,6 +138,15 @@ class MetricsRegistry:
     def mesh_degraded_add(self, site: str, n: float = 1) -> None:
         with self._lock:
             self.mesh_degraded[site] = self.mesh_degraded.get(site, 0) + n
+
+    def slice_tenant_set(self, slice_id: str, tenant: str) -> None:
+        with self._lock:
+            self.slice_tenants[slice_id] = tenant
+
+    def slice_quarantine_add(self, slice_id: str, n: float = 1) -> None:
+        with self._lock:
+            self.slice_quarantined[slice_id] = (
+                self.slice_quarantined.get(slice_id, 0) + n)
 
     def observe(self, site: str, value: float) -> None:
         with self._lock:
@@ -310,6 +326,14 @@ class MetricsRegistry:
                         k: int(self.mesh_degraded[k])
                         for k in sorted(self.mesh_degraded)}}
                    if self.mesh_degraded else {}),
+                **({"slice_tenants": {
+                        k: self.slice_tenants[k]
+                        for k in sorted(self.slice_tenants)}}
+                   if self.slice_tenants else {}),
+                **({"slice_quarantined": {
+                        k: int(self.slice_quarantined[k])
+                        for k in sorted(self.slice_quarantined)}}
+                   if self.slice_quarantined else {}),
                 "histograms": {
                     k: {"count": int(v[0]), "sum": round(v[1], 3),
                         "min": v[2], "max": v[3]}
@@ -440,11 +464,34 @@ class MetricsRegistry:
                 "over_budget / invalid_config / draining / body_too_large).",
                 [("reason", k, self.serve_rejects[k])
                  for k in sorted(self.serve_rejects)])
-            fam(lines, "tcr_mesh_slice_busy", "gauge",
-                "Per-mesh-slice busy fraction (1 carrying work, 0 "
-                "lost/idle after a degradation).",
-                [("slice", k, self.mesh_slices[k])
-                 for k in sorted(self.mesh_slices)])
+            # the slice-busy family carries an OPTIONAL second label
+            # (tenant occupancy from the serve-plane allocator), so it's
+            # rendered by hand — fam() is the single-label helper
+            if self.mesh_slices:
+                lines.append("# HELP tcr_mesh_slice_busy Per-mesh-slice "
+                             "busy fraction (1 carrying work, 0 lost/idle "
+                             "after a degradation).")
+                lines.append("# TYPE tcr_mesh_slice_busy gauge")
+                for k in sorted(self.mesh_slices):
+                    tenant = self.slice_tenants.get(k)
+                    labels = f'slice="{prom_label(k)}"'
+                    if tenant:
+                        labels += f',tenant="{prom_label(tenant)}"'
+                    lines.append(
+                        f"tcr_mesh_slice_busy{{{labels}}} "
+                        f"{self.mesh_slices[k]:g}")
+            fam(lines, "tcr_slice_quarantined_total", "counter",
+                "Slices quarantined out of the serve-plane free pool "
+                "(device_lost contained to one tenant's slice).",
+                [("slice", k, self.slice_quarantined[k])
+                 for k in sorted(self.slice_quarantined)])
+            if "serve.resident_jobs" in self.gauges_live:
+                lines.append("# HELP tcr_serve_resident_jobs Tenant jobs "
+                             "currently resident on disjoint mesh slices.")
+                lines.append("# TYPE tcr_serve_resident_jobs gauge")
+                lines.append(
+                    f"tcr_serve_resident_jobs "
+                    f"{self.gauges_live['serve.resident_jobs']:g}")
             fam(lines, "tcr_mesh_degraded_total", "counter",
                 "Degraded-mesh re-executions by the fault site that "
                 "caused them.",
@@ -569,53 +616,85 @@ def prom_label(value: str) -> str:
 
 
 # --- process-wide armed registry (same discipline as faults/watchdog) -------
+#
+# Under a jobscope (the slice-packed runner pool) a run's arm() binds its
+# registry THREAD-LOCALLY: each resident tenant job rolls its own
+# telemetry.json while the daemon's process-global registry keeps serving
+# /metrics undisturbed. A scoped job whose telemetry is off (or that has
+# already disarmed) falls back to the daemon registry — exactly the
+# sharing a serial daemon had.
 
 _ARMED: MetricsRegistry | None = None
 
 
+def _current() -> MetricsRegistry | None:
+    reg = jobscope.get("metrics")
+    if reg is not None:
+        return reg
+    return _ARMED
+
+
 def arm() -> MetricsRegistry:
     global _ARMED
-    _ARMED = MetricsRegistry()
-    return _ARMED
+    reg = MetricsRegistry()
+    if jobscope.active():
+        jobscope.set("metrics", reg)
+        return reg
+    _ARMED = reg
+    return reg
 
 
 def disarm() -> None:
     global _ARMED
+    if jobscope.active():
+        jobscope.set("metrics", None)
+        return
     _ARMED = None
 
 
 def armed() -> bool:
-    return _ARMED is not None
+    return _current() is not None
 
 
 def registry() -> MetricsRegistry | None:
+    return _current()
+
+
+def global_registry() -> MetricsRegistry | None:
+    """The process-global armed registry, ignoring any jobscope binding.
+
+    Daemon-plane objects (the slice allocator) plant here even when the
+    calling thread happens to be inside a tenant job's scope — the mesh
+    degrade hook runs the quarantine on the job's own thread, and those
+    gauges/counters must reach the daemon's /metrics, not the tenant's
+    per-run telemetry.json."""
     return _ARMED
 
 
 def counter_add(site: str, n: float = 1) -> None:
     """Count ``n`` at ``site``; free no-op when telemetry is off."""
-    reg = _ARMED
+    reg = _current()
     if reg is not None:
         reg.counter_add(site, n)
 
 
 def gauge_max(site: str, value: float) -> None:
     """Record a high-water observation; free no-op when telemetry is off."""
-    reg = _ARMED
+    reg = _current()
     if reg is not None:
         reg.gauge_max(site, value)
 
 
 def observe(site: str, value: float) -> None:
     """Record a histogram observation; free no-op when telemetry is off."""
-    reg = _ARMED
+    reg = _current()
     if reg is not None:
         reg.observe(site, value)
 
 
 def gauge_set(site: str, value: float) -> None:
     """Record a live (last-value) gauge; free no-op when telemetry is off."""
-    reg = _ARMED
+    reg = _current()
     if reg is not None:
         reg.gauge_set(site, value)
 
@@ -624,7 +703,7 @@ def reject_add(reason: str, n: float = 1) -> None:
     """Count a serve-plane rejection under ``reason``; free no-op when
     telemetry is off. The argument is a label value, not an OBS_SITES
     site — the per-site serve.rejected counter is planted separately."""
-    reg = _ARMED
+    reg = _current()
     if reg is not None:
         reg.reject_add(reason, n)
 
@@ -634,7 +713,7 @@ def mesh_slice_set(slice_id: str, busy: float) -> None:
     free no-op when telemetry is off. The argument is a label value
     (device id), not an OBS_SITES site — the mesh.slice_busy gauge is
     planted separately (parallel/mesh.py ``mark_mesh_slices``)."""
-    reg = _ARMED
+    reg = _current()
     if reg is not None:
         reg.mesh_slice_set(slice_id, busy)
 
@@ -644,16 +723,35 @@ def mesh_degraded_add(site: str, n: float = 1) -> None:
     caused it (``tcr_mesh_degraded_total``); free no-op when telemetry
     is off. The argument is a label value, not an OBS_SITES site — the
     mesh.degraded counter is planted separately (graph/executor.py)."""
-    reg = _ARMED
+    reg = _current()
     if reg is not None:
         reg.mesh_degraded_add(site, n)
+
+
+def slice_tenant_set(slice_id: str, tenant: str) -> None:
+    """Record which tenant job occupies a mesh slice (the tenant label
+    on ``tcr_mesh_slice_busy``); free no-op when telemetry is off. Both
+    arguments are label values, not OBS_SITES sites — the serve.slice
+    ring event is planted separately (serve/slices.py)."""
+    reg = _current()
+    if reg is not None:
+        reg.slice_tenant_set(slice_id, tenant)
+
+
+def slice_quarantine_add(slice_id: str, n: float = 1) -> None:
+    """Count a serve-plane slice quarantine
+    (``tcr_slice_quarantined_total``); free no-op when telemetry is off.
+    The argument is a label value (device id), not an OBS_SITES site."""
+    reg = _current()
+    if reg is not None:
+        reg.slice_quarantine_add(slice_id, n)
 
 
 def graph_node_add(name: str, *, critical_s: float = 0.0,
                    overlapped_s: float = 0.0) -> None:
     """Record one graph-node execution (critical-path seconds vs seconds
     spent on a worker thread); free no-op when telemetry is off."""
-    reg = _ARMED
+    reg = _current()
     if reg is not None:
         reg.graph_node_add(name, critical_s=critical_s,
                            overlapped_s=overlapped_s)
@@ -661,14 +759,14 @@ def graph_node_add(name: str, *, critical_s: float = 0.0,
 
 def graph_node_skip(name: str) -> None:
     """Record a resume skip of a graph node; free no-op when off."""
-    reg = _ARMED
+    reg = _current()
     if reg is not None:
         reg.graph_node_skip(name)
 
 
 def graph_edge_set(name: str, placement: str) -> None:
     """Record a graph edge's declared placement; free no-op when off."""
-    reg = _ARMED
+    reg = _current()
     if reg is not None:
         reg.graph_edge_set(name, placement)
 
@@ -677,7 +775,7 @@ def graph_node_declare(name: str, *, inputs=None, outputs=None,
                        units: int | None = None) -> None:
     """Record a graph node's declared edges / evaluated workload units
     into the telemetry graph section; free no-op when telemetry is off."""
-    reg = _ARMED
+    reg = _current()
     if reg is not None:
         reg.graph_node_declare(name, inputs=inputs, outputs=outputs,
                                units=units)
@@ -686,7 +784,7 @@ def graph_node_declare(name: str, *, inputs=None, outputs=None,
 def pool_add(site: str, *, busy_s: float = 0.0, idle_s: float = 0.0,
              window_s: float = 0.0, slots: int = 0) -> None:
     """Record a worker pool's busy/idle split; free no-op when off."""
-    reg = _ARMED
+    reg = _current()
     if reg is not None:
         reg.pool_add(site, busy_s=busy_s, idle_s=idle_s, window_s=window_s,
                      slots=slots)
@@ -695,6 +793,6 @@ def pool_add(site: str, *, busy_s: float = 0.0, idle_s: float = 0.0,
 def analysis_set(name: str, summary: dict) -> None:
     """Record a static-analyzer verdict summary (graftcheck) into the
     telemetry artifact; free no-op when telemetry is off."""
-    reg = _ARMED
+    reg = _current()
     if reg is not None:
         reg.analysis_set(name, summary)

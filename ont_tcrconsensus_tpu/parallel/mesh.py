@@ -22,6 +22,8 @@ Nothing here requires N physical chips: tests and the driver's dryrun use
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 import jax
@@ -29,18 +31,65 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
 from ont_tcrconsensus_tpu.obs import transfers as obs_transfers
-from ont_tcrconsensus_tpu.robustness import faults
+from ont_tcrconsensus_tpu.robustness import faults, jobscope
+
+# --- per-job slice install (serve-plane slice packing) ----------------------
+# The slice-packed runner pool (serve/slices.py + serve/daemon.py) gives
+# each resident tenant job a DISJOINT subset of the local devices. The
+# job's run builds its meshes through the unchanged make_mesh default
+# path, so the restriction rides the job's scope: the runner installs the
+# slice before dispatch, and every make_mesh inside that job — including
+# on overlap stage workers, which adopt the scope — sees only the slice's
+# devices. A thread-local fallback serves unscoped callers (unit tests);
+# plain threads — every one-shot CLI run — see jax.local_devices()
+# exactly as before.
+_TLS = threading.local()
+
+
+def install_slice_devices(devices) -> None:
+    """Restrict ``make_mesh``'s default device set for the calling job
+    scope (or thread, unscoped); ``None`` clears. Owned by the
+    serve-plane runner pool."""
+    devs = list(devices) if devices is not None else None
+    if jobscope.active():
+        jobscope.set("slice_devices", devs)
+        return
+    _TLS.devices = devs
+
+
+def slice_devices():
+    """The calling job's installed slice devices (None = whole host)."""
+    devs = jobscope.get("slice_devices")
+    if devs is not None:
+        return devs
+    return getattr(_TLS, "devices", None)
+
+
+def install_degrade_hook(hook) -> None:
+    """Install a callable(lost_devices) fired when :func:`degrade_mesh`
+    drops a data slice inside the calling job scope (or thread, unscoped);
+    ``None`` clears. The runner pool uses it to quarantine the lost
+    devices out of the allocator's free pool — the fault stays the losing
+    tenant's fault."""
+    if jobscope.active():
+        jobscope.set("degrade_hook", hook)
+        return
+    _TLS.degrade_hook = hook
 
 
 def make_mesh(shape: dict[str, int] | None = None, devices=None) -> Mesh:
     """Build a mesh; default puts every device on the data axis.
 
     ``shape`` e.g. {"data": 4, "model": 2}; axis sizes must multiply to the
-    device count used. Defaults to LOCAL devices: the pipeline's meshes are
-    intra-host (chips of one TPU VM), while the cross-host axis is the
-    library shard over gloo/DCN (parallel/distributed.py) — a global-device
-    mesh here would hand every process the same (process-0) chips.
+    device count used. Defaults to LOCAL devices — or, under a serve-plane
+    slice install (:func:`install_slice_devices`), the calling thread's
+    slice of them: the pipeline's meshes are intra-host (chips of one TPU
+    VM), while the cross-host axis is the library shard over gloo/DCN
+    (parallel/distributed.py) — a global-device mesh here would hand every
+    process the same (process-0) chips.
     """
+    if devices is None:
+        devices = slice_devices()
     devices = list(devices if devices is not None else jax.local_devices())
     if not shape:
         shape = {"data": len(devices)}
@@ -171,6 +220,14 @@ def degrade_mesh(mesh: Mesh) -> Mesh | None:
         for d in lost:
             obs_metrics.mesh_slice_set(f"{d.platform}:{d.id}", 0.0)
     mark_mesh_slices(new_mesh)
+    hook = jobscope.get("degrade_hook")
+    if hook is None:
+        hook = getattr(_TLS, "degrade_hook", None)
+    if hook is not None:
+        try:
+            hook(lost)
+        except Exception:
+            pass  # quarantine bookkeeping must never fail the degrade path
     return new_mesh
 
 

@@ -186,6 +186,12 @@ class RunConfig:
     #   tenant job queue depth; a submit beyond this is rejected with
     #   reason "queue_full" instead of queued unboundedly. Ignored by
     #   one-shot runs; excluded from the config fingerprint
+    serve_workers: int = 1  # daemon mode only (serve/daemon.py +
+    #   serve/slices.py): runner-pool width. 1 (default) keeps the serial
+    #   one-job-at-a-time loop; >1 packs up to this many concurrent tenant
+    #   jobs onto disjoint pow2 device slices, each under its own mesh and
+    #   fault-isolation scope. Ignored by one-shot runs; excluded from the
+    #   config fingerprint
     serve_prewarm: bool = True  # daemon mode only (serve/prewarm.py): AOT
     #   lower+compile the fused-assign (and polisher, when weights are
     #   bundled) entry points for the declared width buckets at daemon
@@ -430,6 +436,13 @@ class RunConfig:
         ):
             raise ValueError(
                 f"serve_queue_max={self.serve_queue_max!r} must be a "
+                "positive int"
+            )
+        if not isinstance(self.serve_workers, int) or (
+            isinstance(self.serve_workers, bool) or self.serve_workers < 1
+        ):
+            raise ValueError(
+                f"serve_workers={self.serve_workers!r} must be a "
                 "positive int"
             )
         for pat_name in ("umi_fwd", "umi_rev"):

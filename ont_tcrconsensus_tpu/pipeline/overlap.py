@@ -40,7 +40,7 @@ import time
 
 from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
 from ont_tcrconsensus_tpu.obs import trace
-from ont_tcrconsensus_tpu.robustness import faults, lockcheck, watchdog
+from ont_tcrconsensus_tpu.robustness import faults, jobscope, lockcheck, watchdog
 
 
 class DeferredStage:
@@ -56,9 +56,15 @@ class DeferredStage:
         self._result = None
         self._exc: BaseException | None = None
         self._call: tuple | None = None  # (fn, args, kwargs) for rerun_sync
+        self._scope: dict | None = None  # submitter's jobscope store
         self.worker_seconds = 0.0
 
     def _run(self, fn, args, kwargs) -> None:
+        # a worker spawned by a scoped run (slice-packed serving) joins
+        # its submitter's job scope, so its chaos plants, telemetry and
+        # watchdog guards land in its OWN job's state, not a neighbor
+        # tenant's; None (unscoped submitter) is a no-op
+        jobscope.adopt(self._scope)
         # the worker's wall clock is a trace span on THIS thread: its one
         # exit-time measurement is both the `<name>_bg` TSV seconds (via
         # worker_seconds below) and the worker's row on the trace timeline
@@ -174,6 +180,7 @@ class StageExecutor:
         stage = DeferredStage(name, self._permits, units=units,
                               on_done=self._note_done)
         stage._call = (fn, args, kwargs)
+        stage._scope = jobscope.current()
         threading.Thread(
             target=stage._run, args=(fn, args, kwargs),
             name=f"stage-{name}", daemon=True,

@@ -27,8 +27,15 @@ from __future__ import annotations
 import sys
 import threading
 
+from ont_tcrconsensus_tpu.robustness import jobscope
+
 MODES = ("off", "warn", "strict")
 
+# process-wide mode + counters; under a jobscope (slice-packed runner
+# pool) each resident tenant job binds its OWN {mode, checked, violated}
+# state thread-locally so a concurrent run's reset/set_mode never wipes
+# another tenant's counters mid-flight. The module lock guards counter
+# mutation for both shapes — contention is a handful of int bumps.
 _MODE = "warn"
 _lock = threading.Lock()
 _checked: dict[str, int] = {}
@@ -39,7 +46,22 @@ class ContractViolation(RuntimeError):
     """A conservation invariant failed under ``contracts=strict``."""
 
 
+def _scoped_state() -> dict | None:
+    return jobscope.get("contracts")
+
+
+def _ensure_scoped() -> dict:
+    st = jobscope.get("contracts")
+    if st is None:
+        st = {"mode": _MODE, "checked": {}, "violated": {}}
+        jobscope.set("contracts", st)
+    return st
+
+
 def mode() -> str:
+    st = _scoped_state()
+    if st is not None:
+        return st["mode"]
     return _MODE
 
 
@@ -47,12 +69,21 @@ def set_mode(new_mode: str) -> str:
     global _MODE
     if new_mode not in MODES:
         raise ValueError(f"contracts mode {new_mode!r} not in {MODES}")
+    if jobscope.active():
+        _ensure_scoped()["mode"] = new_mode
+        return new_mode
     _MODE = new_mode
     return _MODE
 
 
 def reset() -> None:
     """Clear the per-run check/violation counters (run start)."""
+    if jobscope.active():
+        st = _ensure_scoped()
+        with _lock:
+            st["checked"].clear()
+            st["violated"].clear()
+        return
     with _lock:
         _checked.clear()
         _violated.clear()
@@ -60,7 +91,11 @@ def reset() -> None:
 
 def summary() -> dict:
     """{checked: {name: n}, violated: {name: n}} for the robustness report."""
+    st = _scoped_state()
     with _lock:
+        if st is not None:
+            return {"mode": st["mode"], "checked": dict(st["checked"]),
+                    "violated": dict(st["violated"])}
         return {"mode": _MODE, "checked": dict(_checked),
                 "violated": dict(_violated)}
 
@@ -73,14 +108,18 @@ def check_equal(name: str, lhs_desc: str, lhs, rhs_desc: str, rhs,
     recorder (site ``contracts.<name>``), logged to stderr under ``warn``,
     and raised as :class:`ContractViolation` under ``strict``.
     """
-    if _MODE == "off":
+    active_mode = mode()
+    st = _scoped_state()
+    checked = st["checked"] if st is not None else _checked
+    violated = st["violated"] if st is not None else _violated
+    if active_mode == "off":
         return True
     with _lock:
-        _checked[name] = _checked.get(name, 0) + 1
+        checked[name] = checked.get(name, 0) + 1
     if lhs == rhs:
         return True
     with _lock:
-        _violated[name] = _violated.get(name, 0) + 1
+        violated[name] = violated.get(name, 0) + 1
     message = (f"conservation contract {name!r} violated: "
                f"{lhs_desc} ({lhs!r}) != {rhs_desc} ({rhs!r})")
     from ont_tcrconsensus_tpu.robustness import retry
@@ -89,7 +128,7 @@ def check_equal(name: str, lhs_desc: str, lhs, rhs_desc: str, rhs,
         f"contracts.{name}", classification="contract", outcome="violation",
         error=message, detail=detail,
     )
-    if _MODE == "strict":
+    if active_mode == "strict":
         raise ContractViolation(message)
     print(f"WARNING: {message}", file=sys.stderr)
     return False

@@ -43,6 +43,20 @@ site                     planted at
 ``serve.prewarm``        the AOT bucket prewarm (serve/daemon.py) — a
                          failed prewarm must degrade to a report line,
                          never a dead daemon
+``serve.slice_assign``   the slice allocator's carve, after sizing and
+                         before the devices leave the free pool
+                         (serve/slices.py) — a ``transient`` here rides
+                         the job retry ladder, never leaks a slice
+``serve.slice_lost``     the runner-pool worker between slice assignment
+                         and job dispatch (serve/daemon.py) —
+                         ``device-lost`` simulates losing the whole
+                         assigned slice: the slice quarantines, the
+                         tenant's job requeues, every OTHER tenant is
+                         provably untouched
+``serve.pack``           the allocator's release/repack as a job's
+                         devices return to the free pool
+                         (serve/slices.py) — a fault mid-pack must leave
+                         the pool consistent (no leaked devices)
 ``mesh.dispatch``        the sharded placement/dispatch boundary: batch
                          shard placement (parallel/mesh.py, shard_batch)
                          and the engine's shard_map dispatch
@@ -97,6 +111,7 @@ import threading
 import time
 
 from ont_tcrconsensus_tpu.obs import trace as obs_trace
+from ont_tcrconsensus_tpu.robustness import jobscope
 
 ENV_VAR = "TCR_CHAOS"
 
@@ -141,6 +156,9 @@ KNOWN_SITES = frozenset({
     "serve.daemon_loop",
     "serve.journal_write",
     "serve.prewarm",
+    "serve.slice_assign",
+    "serve.slice_lost",
+    "serve.pack",
     "mesh.dispatch",
     "mesh.device_lost",
     "mesh.slice_oom",
@@ -233,11 +251,24 @@ class FaultPlan:
             }
 
 
+# process-wide plan; under a jobscope (the slice-packed runner pool)
+# each tenant job's run arms/disarms a THREAD-SCOPED plan instead, so
+# tenant A's chaos declaration can never fire inside (or be disarmed by)
+# tenant B's concurrent run. The scope stores a 1-tuple so an explicit
+# in-scope disarm (a job declaring "no chaos") tombstones rather than
+# falling back to the daemon's serve-scope plan.
 _PLAN: FaultPlan | None = None
 
 
+def _current_plan() -> FaultPlan | None:
+    entry = jobscope.get("faults")
+    if entry is not None:
+        return entry[0]
+    return _PLAN
+
+
 def active() -> bool:
-    return _PLAN is not None
+    return _current_plan() is not None
 
 
 def arm(specs, seed: int = 0) -> FaultPlan:
@@ -246,8 +277,12 @@ def arm(specs, seed: int = 0) -> FaultPlan:
     parsed = [
         s if isinstance(s, FaultSpec) else FaultSpec(**s) for s in specs
     ]
-    _PLAN = FaultPlan(parsed, seed=seed)
-    return _PLAN
+    plan = FaultPlan(parsed, seed=seed)
+    if jobscope.active():
+        jobscope.set("faults", (plan,))
+    else:
+        _PLAN = plan
+    return plan
 
 
 def arm_from_env() -> FaultPlan | None:
@@ -267,19 +302,24 @@ def arm_from_env() -> FaultPlan | None:
 
 def disarm() -> None:
     global _PLAN
+    if jobscope.active():
+        jobscope.set("faults", (None,))
+        return
     _PLAN = None
 
 
 def describe() -> dict | None:
-    return _PLAN.describe() if _PLAN is not None else None
+    plan = _current_plan()
+    return plan.describe() if plan is not None else None
 
 
 def fired(site: str) -> int:
     """How many times any spec fired at ``site`` (0 when disarmed)."""
-    if _PLAN is None:
+    plan = _current_plan()
+    if plan is None:
         return 0
-    with _PLAN._lock:
-        return _PLAN._fired.get(site, 0)
+    with plan._lock:
+        return plan._fired.get(site, 0)
 
 
 def _note_fire(site: str, kind: str) -> None:
@@ -362,9 +402,10 @@ def _stall_until_cancelled(kind: str, site: str) -> None:
 
 def inject(site: str) -> None:
     """Raise/kill/preempt per the armed plan; free no-op when disarmed."""
-    if _PLAN is None:
+    plan = _current_plan()
+    if plan is None:
         return
-    spec = _PLAN.hit(site)
+    spec = plan.hit(site)
     if spec is not None:
         _fire(spec, site)
 
@@ -416,9 +457,10 @@ def mutate_input(site: str, path: str) -> str:
     through :func:`_fire` as usual. No-op (returns ``path``) when
     disarmed.
     """
-    if _PLAN is None:
+    plan = _current_plan()
+    if plan is None:
         return path
-    spec = _PLAN.hit(site)
+    spec = plan.hit(site)
     if spec is None:
         return path
     if spec.kind not in ("corrupt-input", "truncate-file"):
@@ -427,7 +469,7 @@ def mutate_input(site: str, path: str) -> str:
     _note_fire(site, spec.kind)
     import gzip
 
-    rng = random.Random(f"{_PLAN.seed}:{site}:{spec.kind}")
+    rng = random.Random(f"{plan.seed}:{site}:{spec.kind}")
     if spec.kind == "truncate-file":
         # cut the RAW file bytes mid-stream: for .gz inputs this truncates
         # the gzip stream itself (the BadGzipFile/gzread-error path)
@@ -475,9 +517,10 @@ def corrupt_artifact(site: str, path: str) -> bool:
     garbage flows through) instead of a parse crash. Returns True when it
     fired; other armed kinds at the site fire through :func:`_fire`.
     """
-    if _PLAN is None:
+    plan = _current_plan()
+    if plan is None:
         return False
-    spec = _PLAN.hit(site)
+    spec = plan.hit(site)
     if spec is None:
         return False
     if spec.kind != "corrupt-artifact":
@@ -511,9 +554,10 @@ def tear_write(site: str, path: str, payload: str) -> bool:
     mid-write — the caller must skip its own atomic write. Other armed
     kinds at the site fire through :func:`_fire` as usual.
     """
-    if _PLAN is None:
+    plan = _current_plan()
+    if plan is None:
         return False
-    spec = _PLAN.hit(site)
+    spec = plan.hit(site)
     if spec is None:
         return False
     if spec.kind != "torn":

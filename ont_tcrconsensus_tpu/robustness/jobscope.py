@@ -1,0 +1,97 @@
+"""Per-job thread scope for the process-global observability/robustness
+singletons (the slice-packed serving concurrency contract).
+
+Every run arms a set of process-global registries — the metrics registry
+(obs/metrics.py), the chaos plan (faults.py), the retry policy/recorder
+(retry.py), the watchdog (watchdog.py), the contract counters
+(contracts.py), the shutdown coordinator (shutdown.py) and the live
+plane's node-start hook (obs/live.py). One job at a time, that is
+exactly the right shape: deep stage code reaches its run's state with a
+single module-attribute check, no signature plumbing.
+
+The serve plane's slice-packed worker pool (serve/daemon.py +
+serve/slices.py) breaks the one-at-a-time assumption: two tenant jobs
+run :func:`~..pipeline.run.run_with_config` CONCURRENTLY on disjoint
+mesh slices, and each run's arm/disarm of those globals would clobber
+the other tenant mid-flight (job B's recorder reset wiping job A's
+robustness events is a correctness bug, not a cosmetic one).
+
+This module is the fix: a thread-local OVERLAY store. A runner-pool
+worker enters the scope before dispatching its job; while the scope is
+active, each singleton module's ``arm``/``set_*`` binds into the
+thread's store instead of the module global, and its resolution helper
+reads the store first. Threads outside any scope — the daemon loop, the
+HTTP handlers, every one-shot CLI run — see the module globals exactly
+as before: unscoped behavior is byte-for-byte the status quo.
+
+Scope inheritance: threads SPAWNED by a scoped run (the overlap
+executor's deferred-stage workers) adopt the submitting thread's store
+via :func:`current`/:func:`adopt`, so a background QC stage's telemetry
+and chaos plants land in its own job's scope, not a random tenant's.
+The store is shared by reference on purpose — one scope per job, however
+many threads serve it.
+
+Known boundary: module globals that are process-wide by NATURE (the
+live plane's HTTP server and flight ring, the compilation cache) stay
+shared; the daemon owns them and jobs only feed them.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_TLS = threading.local()
+
+#: store keys are owned by the scoped modules; listed here only as the
+#: vocabulary of the overlay ("metrics", "faults", "retry_policy",
+#: "retry_recorder", "watchdog", "contracts", "shutdown",
+#: "node_start_hook", "flush_path", "slice_devices", "degrade_hook").
+
+
+def enter() -> None:
+    """Enter a job scope on the calling thread (runner-pool worker,
+    immediately before dispatching a tenant job)."""
+    _TLS.store = {}
+
+
+def exit() -> None:
+    """Leave the scope; the thread sees the module globals again."""
+    _TLS.store = None
+
+
+def active() -> bool:
+    return getattr(_TLS, "store", None) is not None
+
+
+def current() -> dict | None:
+    """The calling thread's store (None outside any scope) — capture at
+    spawn time to hand a child worker via :func:`adopt`."""
+    return getattr(_TLS, "store", None)
+
+
+def adopt(store: dict | None) -> None:
+    """Adopt a parent thread's store (child workers of a scoped run).
+    ``None`` is a no-op so unscoped submitters stay unscoped."""
+    if store is not None:
+        _TLS.store = store
+
+
+def set(key: str, value) -> None:
+    """Bind ``key`` in the active scope; silently a no-op when unscoped
+    (callers decide between global and scoped via :func:`active`)."""
+    store = getattr(_TLS, "store", None)
+    if store is not None:
+        store[key] = value
+
+
+def get(key: str, default=None):
+    """Scoped value for ``key``; ``default`` when unscoped or unset.
+
+    Scoped modules distinguish "unset" (fall back to the module global)
+    from an explicit tombstone (the scope armed then disarmed) by
+    storing ``(value,)`` tuples or sentinel defaults as they see fit.
+    """
+    store = getattr(_TLS, "store", None)
+    if store is None:
+        return default
+    return store.get(key, default)

@@ -42,6 +42,8 @@ LOCK_OWNERSHIP = {
     "MetricsRegistry.serve_rejects": "_lock",
     "MetricsRegistry.mesh_slices": "_lock",
     "MetricsRegistry.mesh_degraded": "_lock",
+    "MetricsRegistry.slice_tenants": "_lock",
+    "MetricsRegistry.slice_quarantined": "_lock",
     "MetricsRegistry.hists": "_lock",
     "MetricsRegistry.stages": "_lock",
     "MetricsRegistry.dispatch": "_lock",
@@ -85,6 +87,13 @@ LOCK_OWNERSHIP = {
     # raced by the monitor; _on_hard's cancel-safety proof relies on
     # every write being locked
     "Watchdog._entries": "_lock",
+    # --- serve/slices.py: the slice pool is mutated by the dispatcher
+    # (assign), runner workers (release/quarantine — including the mesh
+    # degrade hook firing mid-run on a job thread) and read by HTTP
+    # submit threads (admission_budget); an unlocked write double-leases
+    # a slice across tenants
+    "SliceAllocator._state": "_lock",
+    "SliceAllocator._leases": "_lock",
 }
 
 #: Mutable containers on registered classes that are deliberately NOT
@@ -95,6 +104,10 @@ LOCK_EXEMPT = {
     "StageExecutor._pending": (
         "main-thread only: submit/commit/wait_all all run on the "
         "library loop thread; workers never touch the pending list"
+    ),
+    "SliceAllocator.devices": (
+        "written once in __init__ before any thread sees the allocator; "
+        "read-only (index order IS the slice address space) afterwards"
     ),
 }
 

@@ -35,7 +35,7 @@ import threading
 import time
 
 from ont_tcrconsensus_tpu.obs import trace
-from ont_tcrconsensus_tpu.robustness import faults, watchdog
+from ont_tcrconsensus_tpu.robustness import faults, jobscope, watchdog
 
 #: substrings marking an exception as HBM/host memory exhaustion. Checked
 #: BEFORE the transient markers: XLA OOM messages often also mention the
@@ -210,21 +210,45 @@ class RobustnessRecorder:
 
 # process-wide active policy/recorder: the deep dispatch sites (stages.py
 # chunk loops, overlap commits) reach them without signature plumbing;
-# run.py swaps in the config-derived policy at run start
+# run.py swaps in the config-derived policy at run start. Under a jobscope
+# (the slice-packed runner pool) each resident tenant job binds its OWN
+# recorder/policy thread-locally so concurrent runs never clobber each
+# other's robustness events — the first scoped access creates the scoped
+# recorder, and child stage workers adopt the same store by reference.
 _RECORDER = RobustnessRecorder()
 _POLICY = RetryPolicy()
 
 
-def recorder() -> RobustnessRecorder:
+def _active_recorder() -> RobustnessRecorder:
+    if jobscope.active():
+        rec = jobscope.get("retry_recorder")
+        if rec is None:
+            rec = RobustnessRecorder()
+            jobscope.set("retry_recorder", rec)
+        return rec
     return _RECORDER
 
 
-def policy() -> RetryPolicy:
+def _active_policy() -> RetryPolicy:
+    pol = jobscope.get("retry_policy")
+    if pol is not None:
+        return pol
     return _POLICY
+
+
+def recorder() -> RobustnessRecorder:
+    return _active_recorder()
+
+
+def policy() -> RetryPolicy:
+    return _active_policy()
 
 
 def set_policy(p: RetryPolicy) -> RetryPolicy:
     global _POLICY
+    if jobscope.active():
+        jobscope.set("retry_policy", p)
+        return p
     _POLICY = p
     return p
 
@@ -246,8 +270,8 @@ def call_with_retry(site: str, fn, *, policy: RetryPolicy | None = None,
     list). The last failure re-raises when the budget is exhausted —
     callers keep their existing degradation paths.
     """
-    pol = policy if policy is not None else _POLICY
-    rec = recorder if recorder is not None else _RECORDER
+    pol = policy if policy is not None else _active_policy()
+    rec = recorder if recorder is not None else _active_recorder()
     attempt = 1
     while True:
         try:

@@ -29,6 +29,8 @@ import signal
 import sys
 import threading
 
+from ont_tcrconsensus_tpu.robustness import jobscope
+
 
 class Preempted(BaseException):
     """Raised at a stage-boundary checkpoint after a shutdown request."""
@@ -131,12 +133,22 @@ class ShutdownCoordinator:
 # an outer coordinator for its accept loop while each job's run.py guard
 # activates an inner one — when the job deactivates, the daemon's
 # coordinator must become active again, not None.
+#
+# Under a jobscope (the slice-packed runner pool) a run's coordinator
+# binds THREAD-LOCALLY instead: each resident tenant job drains on its
+# own coordinator, and a scoped checkpoint ALSO polls the process-global
+# active one — that is how one SIGTERM on the daemon's coordinator
+# preempts every resident job at its next stage boundary while a
+# cooperative request() inside one job never touches its neighbors.
 _ACTIVE: ShutdownCoordinator | None = None
 _STACK: list[ShutdownCoordinator] = []
 
 
 def activate(coord: ShutdownCoordinator) -> ShutdownCoordinator:
     global _ACTIVE
+    if jobscope.active():
+        jobscope.set("shutdown", coord)
+        return coord
     _STACK.append(coord)
     _ACTIVE = coord
     return coord
@@ -146,6 +158,9 @@ def deactivate(coord: ShutdownCoordinator | None = None) -> None:
     """Pop ``coord`` (default: the top) off the active stack; the previous
     coordinator — if any — becomes active again."""
     global _ACTIVE
+    if jobscope.active() and jobscope.get("shutdown") is coord:
+        jobscope.set("shutdown", None)
+        return
     if coord is None:
         if _STACK:
             _STACK.pop()
@@ -157,6 +172,10 @@ def deactivate(coord: ShutdownCoordinator | None = None) -> None:
 def request(reason: str) -> None:
     """Request a cooperative stop on the active coordinator (no-op when
     none is active — e.g. library code called outside run.py)."""
+    coord = jobscope.get("shutdown")
+    if coord is not None:
+        coord.request(reason)
+        return
     if _ACTIVE is not None:
         _ACTIVE.request(reason)
 
@@ -164,5 +183,8 @@ def request(reason: str) -> None:
 def checkpoint(site: str) -> None:
     """Raise :class:`Preempted` here if a stop was requested; free no-op
     otherwise (one global check, same discipline as faults.inject)."""
+    coord = jobscope.get("shutdown")
+    if coord is not None:
+        coord.checkpoint(site)
     if _ACTIVE is not None:
         _ACTIVE.checkpoint(site)

@@ -47,7 +47,7 @@ import sys
 import threading
 import time
 
-from ont_tcrconsensus_tpu.robustness import lockcheck
+from ont_tcrconsensus_tpu.robustness import jobscope, lockcheck
 
 #: soft deadline (stall REPORT) as a fraction of the hard deadline (CANCEL)
 SOFT_FRACTION = 0.5
@@ -344,24 +344,46 @@ class Watchdog:
 
 
 # --- process-wide active watchdog (same discipline as faults/retry) ---------
+#
+# Under a jobscope (slice-packed runner pool) each resident tenant job
+# binds its own watchdog thread-locally: two concurrent runs each get
+# their own monitor with their own deadlines, and neither run's
+# activate/deactivate perturbs the other. The scoped entry is a
+# ``(wd,)`` 1-tuple so an in-scope deactivate tombstones (the scoped
+# thread must NOT fall back to some other run's global watchdog).
 
 _ACTIVE: Watchdog | None = None
 
 
+def _current() -> Watchdog | None:
+    entry = jobscope.get("watchdog")
+    if entry is not None:
+        return entry[0]
+    return _ACTIVE
+
+
 def activate(wd: Watchdog) -> Watchdog:
     global _ACTIVE
+    if jobscope.active():
+        jobscope.set("watchdog", (wd,))
+        return wd
     _ACTIVE = wd
     return wd
 
 
 def deactivate(wd: Watchdog | None = None) -> None:
     global _ACTIVE
+    if jobscope.active():
+        entry = jobscope.get("watchdog")
+        if entry is not None and (wd is None or entry[0] is wd):
+            jobscope.set("watchdog", (None,))
+        return
     if wd is None or _ACTIVE is wd:
         _ACTIVE = None
 
 
 def active() -> bool:
-    return _ACTIVE is not None
+    return _current() is not None
 
 
 def heartbeat(site: str) -> None:
@@ -370,7 +392,7 @@ def heartbeat(site: str) -> None:
     live-plane beat sink (obs/live.py flight recorder) sees every beat —
     heartbeats are progress evidence worth keeping post-mortem even on
     runs where the watchdog itself is disarmed."""
-    wd = _ACTIVE
+    wd = _current()
     if wd is not None:
         wd.beat(site)
     sink = _BEAT_SINK
@@ -380,7 +402,7 @@ def heartbeat(site: str) -> None:
 
 def guard(name: str, units: int = 0):
     """Stage scope context manager; ``nullcontext`` when disarmed."""
-    wd = _ACTIVE
+    wd = _current()
     if wd is None:
         return contextlib.nullcontext()
     return wd.guard(name, units)
@@ -389,13 +411,13 @@ def guard(name: str, units: int = 0):
 def active_deadline_s() -> float | None:
     """The calling thread's current hard deadline (None when unguarded /
     disarmed) — the chaos ``hang`` kind sizes its wedge from this."""
-    wd = _ACTIVE
+    wd = _current()
     return wd.current_deadline_s() if wd is not None else None
 
 
 def set_log_path(path: str | os.PathLike[str]) -> None:
     """Point stall stack dumps at the current library's log file."""
-    wd = _ACTIVE
+    wd = _current()
     if wd is not None:
         wd.log_path = os.fspath(path)
 
@@ -403,7 +425,7 @@ def set_log_path(path: str | os.PathLike[str]) -> None:
 def snapshot() -> list[dict] | None:
     """Per-stage heartbeat ages (None when the watchdog is disarmed) —
     the live plane's /healthz staleness verdict and /metrics gauges."""
-    wd = _ACTIVE
+    wd = _current()
     return wd.entries_snapshot() if wd is not None else None
 
 

@@ -44,12 +44,14 @@ from ont_tcrconsensus_tpu.obs import live as obs_live
 from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
 from ont_tcrconsensus_tpu.pipeline.config import RunConfig
 from ont_tcrconsensus_tpu.robustness import faults
+from ont_tcrconsensus_tpu.robustness import jobscope
 from ont_tcrconsensus_tpu.robustness import lockcheck
 from ont_tcrconsensus_tpu.robustness import retry as retry_mod
 from ont_tcrconsensus_tpu.robustness import shutdown
 from ont_tcrconsensus_tpu.robustness import watchdog as watchdog_mod
 from ont_tcrconsensus_tpu.serve import prewarm as prewarm_mod
 from ont_tcrconsensus_tpu.serve import queue as queue_mod
+from ont_tcrconsensus_tpu.serve import slices as slices_mod
 
 SERVE_INFO_BASENAME = "serve_info.json"
 
@@ -72,7 +74,8 @@ class Daemon:
 
     def __init__(self, template: dict, *, port: int, state_dir: str,
                  queue_max: int | None = None, do_prewarm: bool | None = None,
-                 prewarm_widths: list[int] | None = None):
+                 prewarm_widths: list[int] | None = None,
+                 workers: int | None = None):
         # runtime lockset twin: arm before the JobQueue (and later the
         # daemon-owned metrics/live registries) pick their lock type
         lockcheck.arm_from_env()
@@ -113,6 +116,24 @@ class Daemon:
         self._stop = threading.Event()
         self._draining = threading.Event()
         self._coord = shutdown.ShutdownCoordinator()
+        # slice-packed runner pool (serve/slices.py): with workers > 1 the
+        # local devices become a pool of disjoint pow2 slices and up to
+        # `workers` jobs run concurrently, each on its own slice in its
+        # own job scope. workers == 1 keeps the serial loop byte-for-byte.
+        self.workers = (workers if workers is not None
+                        else self.template_cfg.serve_workers)
+        self.allocator: slices_mod.SliceAllocator | None = None
+        if self.workers > 1:
+            import jax
+
+            self.allocator = slices_mod.SliceAllocator(
+                jax.local_devices(), self.budget)
+            # admission control turns per-slice: a submit is judged
+            # against the largest grantable slice's allowance, not the
+            # whole mesh (re-swapped as quarantines shrink the pool)
+            self.queue.budget = self.allocator.admission_budget()
+        self._done_lock = threading.Lock()
+        self._preempt_exit = threading.Event()
 
     # --- jobs controller (HTTP handler threads) ----------------------------
 
@@ -146,7 +167,7 @@ class Daemon:
         return 202, snap
 
     def jobs_snapshot(self) -> dict:
-        return {
+        snap = {
             "jobs": self.queue.snapshot(),
             "queue_depth": self.queue.depth(),
             "draining": self._draining.is_set(),
@@ -154,6 +175,12 @@ class Daemon:
             "warmup_s": self.warmup_s,
             "prewarm": self.prewarm_report,
         }
+        if self.allocator is not None:
+            # packed serving: tenants can watch residency + the pool map
+            # (who holds which slice, what's quarantined) over GET /jobs
+            snap["resident_jobs"] = self.allocator.resident()
+            snap["slices"] = self.allocator.snapshot()
+        return snap
 
     def job_snapshot(self, job_id: str) -> dict | None:
         job = self.queue.job(job_id)
@@ -263,32 +290,37 @@ class Daemon:
             self._resume_journal()
             self._prewarm()
             self.warmup_s = round(time.monotonic() - self._t0, 3)
-            _log(f"warm after {self.warmup_s}s; accepting jobs")
-            while True:
-                if self._coord.requested():
-                    exit_code = 143
-                    break
-                if self._stop.is_set():
-                    break
-                job = self.queue.pop(timeout=0.25)
-                if job is None:
-                    continue
-                try:
-                    # loop-crash drill: the popped job must not vanish —
-                    # requeue it so the drain journal in `finally` (and a
-                    # restarted daemon) still carries it
-                    faults.inject("serve.daemon_loop")
-                except BaseException:
-                    self.queue.requeue_front(job)
-                    raise
-                if self._coord.requested() or self._stop.is_set():
-                    # drained between pop and dispatch: back on the head
-                    self.queue.requeue_front(job)
-                    exit_code = 143 if self._coord.requested() else 0
-                    break
-                if not self._run_job(job):
-                    exit_code = 143
-                    break
+            _log(f"warm after {self.warmup_s}s; accepting jobs"
+                 + (f" ({self.workers} packed workers)"
+                    if self.allocator is not None else ""))
+            if self.allocator is not None:
+                exit_code = self._packed_loop()
+            else:
+                while True:
+                    if self._coord.requested():
+                        exit_code = 143
+                        break
+                    if self._stop.is_set():
+                        break
+                    job = self.queue.pop(timeout=0.25)
+                    if job is None:
+                        continue
+                    try:
+                        # loop-crash drill: the popped job must not vanish
+                        # — requeue it so the drain journal in `finally`
+                        # (and a restarted daemon) still carries it
+                        faults.inject("serve.daemon_loop")
+                    except BaseException:
+                        self.queue.requeue_front(job)
+                        raise
+                    if self._coord.requested() or self._stop.is_set():
+                        # drained between pop and dispatch: back on head
+                        self.queue.requeue_front(job)
+                        exit_code = 143 if self._coord.requested() else 0
+                        break
+                    if not self._run_job(job):
+                        exit_code = 143
+                        break
         except BaseException as exc:
             crash = exc
             raise
@@ -315,16 +347,191 @@ class Daemon:
             self._coord.uninstall()
         return exit_code
 
+    # --- the packed (multi-tenant) loop --------------------------------------
+
+    def _packed_loop(self) -> int:
+        """The runner-pool accept loop: up to ``serve_workers`` jobs run
+        concurrently, each on a disjoint device slice in its own job
+        scope. Same drain contract as the serial loop (exit 143 on a
+        signal, 0 on a programmatic stop), extended to N residents: a
+        SIGTERM preempts EVERY resident job at its next stage boundary
+        (each scoped checkpoint also polls the daemon's coordinator) and
+        all of them requeue before the caller journals."""
+        slots = threading.Semaphore(self.workers)
+        workers: list[threading.Thread] = []
+        exit_code = 0
+        while True:
+            if self._coord.requested() or self._preempt_exit.is_set():
+                exit_code = 143
+                break
+            if self._stop.is_set():
+                break
+            # slot BEFORE pop: a popped job must never sit slotless in
+            # dispatcher limbo where a drain would miss it
+            if not slots.acquire(timeout=0.25):
+                continue
+            dispatched = False
+            try:
+                job = self.queue.pop(timeout=0.25)
+                if job is None:
+                    continue
+                try:
+                    # same loop-crash drill as the serial path: the popped
+                    # job must not vanish on a dispatcher fault
+                    faults.inject("serve.daemon_loop")
+                except BaseException:
+                    self.queue.requeue_front(job)
+                    raise
+                if self._coord.requested() or self._stop.is_set():
+                    self.queue.requeue_front(job)
+                    exit_code = 143 if self._coord.requested() else 0
+                    break
+                raw = dict(job.raw)
+                cfg = RunConfig.from_dict(raw)
+                size, detail = self.allocator.size_for(cfg)
+                if size is None:
+                    # admitted once, but the pool shrank underneath it
+                    # (quarantines): fail loudly, never queue forever
+                    self._poison_capacity(job, detail)
+                    continue
+                try:
+                    lease = self.allocator.try_assign(job.id, size)
+                except Exception as exc:
+                    # serve.slice_assign chaos fires before any pool
+                    # mutation; the failure rides the normal ladder
+                    self._finish_if_terminal(
+                        job, self._failure_outcome(job, exc))
+                    continue
+                if lease is None:
+                    if not self.allocator.can_ever_fit(size):
+                        self._poison_capacity(
+                            job, f"no aligned {size}-device run survives "
+                                 f"quarantine")
+                        continue
+                    # fragmentation or full residency: free slices may
+                    # exist but no aligned run this big is free RIGHT NOW
+                    # — the job stays queued (not rejected) and the
+                    # dispatcher waits for a release
+                    self.queue.requeue_front(job)
+                    self.allocator.wait_for_release(0.25)
+                    continue
+                if not raw.get("mesh_shape"):
+                    # packed jobs shard over exactly their slice; a
+                    # tenant-pinned mesh_shape is honored as-is (the
+                    # lease was sized to cover it)
+                    raw["mesh_shape"] = {"data": lease.size}
+                    cfg = RunConfig.from_dict(raw)
+                t = threading.Thread(
+                    target=self._slice_worker,
+                    args=(job, cfg, lease, slots),
+                    name=f"serve-worker-{job.id}", daemon=True)
+                workers.append(t)
+                dispatched = True
+                t.start()
+                obs_metrics.gauge_set(
+                    "serve.resident_jobs",
+                    float(self.allocator.resident()))
+            finally:
+                if not dispatched:
+                    slots.release()
+        # stop dispatching, then wait for the residents: they finish
+        # (programmatic stop) or preempt at the next stage boundary
+        # (signal), and their requeues must land before the drain journal
+        for t in workers:
+            t.join()
+        if self._preempt_exit.is_set():
+            exit_code = 143
+        return exit_code
+
+    def _slice_worker(self, job: queue_mod.Job, cfg: RunConfig,
+                      lease: slices_mod.SliceLease,
+                      slots: threading.Semaphore) -> None:
+        """One runner-pool worker: run the job on its slice, then return
+        the slice to the pool (quarantined devices stay out) and free the
+        slot. A drain mid-run (False from _run_job) stops the
+        dispatcher."""
+        ok = True
+        try:
+            ok = self._run_job(job, cfg=cfg, lease=lease)
+        except BaseException as exc:
+            # _run_job owns job failures; anything escaping it is
+            # daemon-plane plumbing — log it, keep the pool consistent
+            _log(f"{job.id}: worker crashed outside the job ladder: "
+                 f"{exc!r}")
+        finally:
+            try:
+                self.allocator.release(job.id)
+            except Exception as exc:
+                # serve.pack chaos fires AFTER the devices are freed: the
+                # pool is consistent, the fault is observability only
+                _log(f"{job.id}: pack fault after release: {exc!r}")
+            slots.release()
+            obs_metrics.gauge_set(
+                "serve.resident_jobs", float(self.allocator.resident()))
+            if not ok:
+                self._preempt_exit.set()
+
+    def _on_slice_degrade(self, job: queue_mod.Job,
+                          lease: slices_mod.SliceLease, lost) -> None:
+        """Degrade-hook for a packed job's mesh (parallel/mesh.py calls it
+        from degrade_mesh, on the job's own thread): the run survived a
+        device loss by remeshing WITHIN its slice, so only the dead
+        devices leave the pool — no later tenant can land on them, and
+        admission shrinks to the surviving capacity. Tenant isolation is
+        structural: the hook only ever touches this job's lease."""
+        labels = self.allocator.quarantine(job.id, lost_devices=lost)
+        self.queue.budget = self.allocator.admission_budget()
+        _log(f"{job.id}: degraded within slice {lease.slice_id}; "
+             f"quarantined {labels}")
+
+    def _poison_capacity(self, job: queue_mod.Job, detail: str) -> None:
+        """No surviving slice can EVER admit this job (quarantines ate
+        the capacity it was admitted against): quarantine it durably and
+        loudly instead of letting it wait for a release that cannot
+        help."""
+        path = queue_mod.append_poison(
+            self.state_dir, job, classification="capacity_lost",
+            error=detail)
+        self.queue.mark(job, "poisoned", error=f"capacity_lost: {detail}")
+        with self._done_lock:
+            self.jobs_done += 1
+        obs_live.ring_event("serve.job", {"id": job.id, "event": "poisoned"})
+        _log(f"{job.id}: poisoned (capacity_lost): {detail}; -> {path}")
+
+    def _finish_if_terminal(self, job: queue_mod.Job,
+                            outcome: _JobOutcome) -> None:
+        """Record a terminal outcome produced outside _run_job (dispatch-
+        time failures); a "retry" outcome already requeued the job."""
+        if outcome.state == "retry":
+            return
+        self.queue.mark(job, outcome.state, error=outcome.error,
+                        result=outcome.result)
+        with self._done_lock:
+            self.jobs_done += 1
+        obs_live.ring_event("serve.job", {"id": job.id,
+                                          "event": outcome.state})
+        _log(f"{job.id}: {outcome.state}: {outcome.error}")
+
     # --- one job -------------------------------------------------------------
 
-    def _run_job(self, job: queue_mod.Job) -> bool:
+    def _run_job(self, job: queue_mod.Job, cfg: RunConfig | None = None,
+                 lease: slices_mod.SliceLease | None = None) -> bool:
         """Run one job through the unchanged pipeline; False = drained
-        mid-job (the job is requeued + the caller exits the loop)."""
+        mid-job (the job is requeued + the caller exits the loop).
+
+        With ``lease`` (packed serving) the run executes inside its own
+        job scope: chaos plans, telemetry registries, watchdog guards,
+        contracts and the run's shutdown coordinator bind to this worker
+        thread's store, and the mesh comes up over the lease's devices —
+        so nothing the job arms or disarms can perturb the daemon plane
+        or a neighbor tenant. Daemon bookkeeping (requeue/mark/ledger)
+        runs OUTSIDE the scope so it lands in the daemon registries."""
         from ont_tcrconsensus_tpu.pipeline import run as run_mod
 
         obs_live.ring_event("serve.job", {"id": job.id, "event": "start"})
         _log(f"{job.id}: starting (waited {job.wait_s:.3f}s)")
-        cfg = RunConfig.from_dict(dict(job.raw))
+        if cfg is None:
+            cfg = RunConfig.from_dict(dict(job.raw))
         t_dispatch = time.monotonic()
 
         def first_stage_hook(name: str) -> None:
@@ -332,11 +539,32 @@ class Daemon:
             obs_live.set_node_start_hook(None)
             obs_metrics.observe("serve.first_stage_s", job.first_stage_s)
 
-        obs_live.set_node_start_hook(first_stage_hook)
         outcome = _JobOutcome("done")
         try:
-            self._inject_job_chaos(job, cfg)
-            results = run_mod.run_with_config(cfg)
+            try:
+                if lease is not None:
+                    from ont_tcrconsensus_tpu.parallel import mesh as mesh_mod
+
+                    # everything from here to the inner finally runs in
+                    # THIS job's scope; the daemon plane and the other
+                    # residents never see it
+                    jobscope.enter()
+                    mesh_mod.install_slice_devices(lease.devices)
+                    mesh_mod.install_degrade_hook(
+                        lambda lost: self._on_slice_degrade(
+                            job, lease, lost))
+                obs_live.set_node_start_hook(first_stage_hook)
+                self._inject_job_chaos(job, cfg)
+                if lease is not None:
+                    # slice-loss drill: the raise classifies as
+                    # device_lost below, quarantining only THIS tenant's
+                    # slice and requeuing only this job
+                    faults.inject("serve.slice_lost")
+                results = run_mod.run_with_config(cfg)
+            finally:
+                obs_live.set_node_start_hook(None)
+                if lease is not None:
+                    jobscope.exit()
             outcome.result = {
                 "libraries": {
                     lib: sum(regions.values())
@@ -355,12 +583,15 @@ class Daemon:
                  f"resume=true")
             return False
         except Exception as exc:
-            outcome = self._failure_outcome(job, exc)
+            outcome = self._failure_outcome(job, exc, lease=lease)
         finally:
-            obs_live.set_node_start_hook(None)
-            # the job's run disarmed its registry on exit; re-arm a fresh
-            # daemon-scope one so between-job /metrics scrapes stay live
-            obs_metrics.arm()
+            if lease is None:
+                # serial mode: the job's run disarmed the global registry
+                # on exit; re-arm a fresh daemon-scope one so between-job
+                # /metrics scrapes stay live. A scoped (packed) run
+                # disarmed only its OWN registry — re-arming here would
+                # instead wipe the daemon's counters mid-flight.
+                obs_metrics.arm()
             obs_metrics.gauge_set("serve.queue_depth", self.queue.depth())
         if outcome.state == "retry":
             # back in the queue with backoff — not terminal, not counted
@@ -368,7 +599,8 @@ class Daemon:
         job_s = time.monotonic() - t_dispatch
         self.queue.mark(job, outcome.state, error=outcome.error,
                         result=outcome.result)
-        self.jobs_done += 1
+        with self._done_lock:
+            self.jobs_done += 1
         obs_live.ring_event("serve.job", {
             "id": job.id, "event": outcome.state,
         })
@@ -407,15 +639,40 @@ class Daemon:
         else:
             faults.inject("serve.job_slow")
 
-    def _failure_outcome(self, job: queue_mod.Job,
-                         exc: Exception) -> _JobOutcome:
+    def _failure_outcome(self, job: queue_mod.Job, exc: Exception,
+                         lease: slices_mod.SliceLease | None = None,
+                         ) -> _JobOutcome:
         """The retry/poison ladder. Transient failures requeue with
         seeded backoff up to ``retry_max_attempts``; anything fatal — or
         a transient that exhausts its attempts — is quarantined durably
         to ``serve_poison.json`` with a machine-readable reason, so one
-        bad tenant job can never wedge the loop."""
+        bad tenant job can never wedge the loop.
+
+        Packed serving adds a rung: a ``device_lost`` that ESCAPED a
+        leased run (the mesh could not degrade within the slice) means
+        the slice is gone but the job is fine — the slice's devices are
+        quarantined, admission shrinks to the surviving pool, and the job
+        requeues for a fresh slice with ``resume=true`` (its committed
+        stages carry over)."""
         job.attempts += 1
         cls = retry_mod.classify(exc)
+        if (lease is not None and cls == "device_lost"
+                and job.attempts < self.retry_policy.max_attempts):
+            labels = self.allocator.quarantine(job.id)
+            self.queue.budget = self.allocator.admission_budget()
+            delay = self.retry_policy.delay(job.attempts)
+            retry_mod.recorder().record(
+                "serve.slice_lost", classification=cls,
+                outcome="slice_quarantined", attempt=job.attempts,
+                error=repr(exc), detail={"devices": labels})
+            job.raw["resume"] = True
+            self.queue.requeue_back(job, delay_s=delay)
+            obs_live.ring_event("serve.job", {
+                "id": job.id, "event": "retry", "attempt": job.attempts})
+            _log(f"{job.id}: lost slice {lease.slice_id} "
+                 f"({len(labels)} device(s) quarantined): {exc!r}; "
+                 f"requeued for a fresh slice")
+            return _JobOutcome("retry")
         if (cls == "transient"
                 and job.attempts < self.retry_policy.max_attempts):
             delay = self.retry_policy.delay(job.attempts)
@@ -488,6 +745,10 @@ def serve_main(argv: list[str] | None = None) -> int:
                              "template")
     parser.add_argument("--queue-max", type=int, default=None,
                         help="override the template's serve_queue_max")
+    parser.add_argument("--workers", type=int, default=None,
+                        help="override the template's serve_workers "
+                             "(>1 = slice-packed runner pool: concurrent "
+                             "tenants on disjoint device slices)")
     parser.add_argument("--no-prewarm", action="store_true",
                         help="skip the AOT bucket prewarm (first job "
                              "compiles lazily)")
@@ -506,5 +767,6 @@ def serve_main(argv: list[str] | None = None) -> int:
         template, port=args.port, state_dir=state_dir,
         queue_max=args.queue_max,
         do_prewarm=False if args.no_prewarm else None,
+        workers=args.workers,
     )
     return daemon.serve_forever()

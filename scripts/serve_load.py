@@ -15,6 +15,12 @@ submission kinds at the same offsets):
   EXACT queue_full 429 counts, one mid-drain 503, drain with jobs still
   queued -> journal -> restarted daemon resumes -> every accepted job
   completes. Seconds-fast; the tier-1 load-smoke stage runs this.
+- ``packed``    — in-process daemon, stub runner, ``--workers`` slice-
+  packed runner pool: a gate holds every stub job mid-run until >= 2
+  tenants are provably resident AT ONCE on pairwise-disjoint device
+  slices (concurrency high-water + disjointness land in the report as
+  invariants), then completes everything under the same exact ledger.
+  The tier-1 slice-pack smoke runs this.
 - ``sustained`` — in-process daemon, real pipeline: N tenants served
   back-to-back through one warm process; p50/p99 job wait,
   dispatch-to-first-stage latency, reads/s over the busy window,
@@ -489,6 +495,175 @@ def scenario_smoke(args) -> dict:
     return report
 
 
+# --- scenario: packed ---------------------------------------------------------
+
+
+def scenario_packed(args) -> dict:
+    """Slice-packed runner pool under load, stub runner: ``--workers``
+    jobs resident AT ONCE on disjoint device slices. A gate holds every
+    stub job mid-run until the concurrency high-water has provably
+    reached the pool width, and the packed invariants ride the same
+    exact ledger as every other scenario:
+
+        submitted == accepted + sum(rejected_by_reason)
+        resident high-water >= 2
+        concurrent leases pairwise disjoint
+    """
+    from ont_tcrconsensus_tpu.pipeline import run as run_mod
+    from ont_tcrconsensus_tpu.robustness import shutdown
+    from ont_tcrconsensus_tpu.serve.daemon import Daemon
+
+    report = base_report(args, "packed")
+    state_dir = os.path.join(args.workdir, "state")
+    template = {"reference_file": os.path.join(args.workdir, "r.fa"),
+                "fastq_pass_dir": os.path.join(args.workdir, "fq")}
+    gate = threading.Event()
+
+    def stub_run(cfg):
+        deadline = time.monotonic() + 60.0
+        while not gate.is_set() and time.monotonic() < deadline:
+            # the daemon's drain must be able to preempt a gated stub
+            # exactly like a real run at a stage boundary
+            shutdown.checkpoint("stub.run")
+            time.sleep(0.01)
+        time.sleep(args.stub_job_s)
+        return {"barcode01": {"region0": 1}}
+
+    real_run = run_mod.run_with_config
+    run_mod.run_with_config = stub_run
+    ledger = Ledger()
+    high_water = 0
+    disjoint_ok = True
+    overlap_seen: list[str] = []
+    try:
+        daemon = Daemon(template, port=0, state_dir=state_dir,
+                        queue_max=max(args.queue_max, args.tenants),
+                        do_prewarm=False, workers=args.workers)
+        if daemon.allocator is None:
+            raise RuntimeError(
+                f"packed scenario needs a runner pool (workers="
+                f"{args.workers} gave no allocator)")
+        th, out = _start_daemon_thread(daemon)
+        srv = _wait_live_server()
+        jobs_url = f"http://127.0.0.1:{srv.port}/jobs"
+
+        # the seeded mix, same as smoke: refusals stay exactly metered
+        # while the accepted jobs pile onto the pool behind the gate
+        schedule = build_schedule(args.seed, parse_mix(args.mix),
+                                  args.period_s)
+        run_schedule(jobs_url, schedule, template, ledger)
+
+        # hold the gate until the pool is provably packed: >= 2 tenants
+        # resident at once on pairwise-disjoint slices
+        deadline = time.monotonic() + args.timeout_s
+        while time.monotonic() < deadline:
+            snap = daemon.jobs_snapshot()
+            leases = snap.get("slices", {}).get("leases", {})
+            high_water = max(high_water, snap.get("resident_jobs", 0))
+            claimed: set[str] = set()
+            for job_id, lease in sorted(leases.items()):
+                devs = set(lease["devices"])
+                if claimed & devs:
+                    disjoint_ok = False
+                claimed |= devs
+            if len(leases) >= 2 and not overlap_seen:
+                overlap_seen = sorted(
+                    f"{jid}@{lease['slice']}"
+                    for jid, lease in leases.items())
+            if high_water >= min(2, args.workers):
+                break
+            time.sleep(0.02)
+        # scrape /metrics while the pool is still packed: the tenant
+        # labels on tcr_mesh_slice_busy only exist while leases are live
+        metrics_txt = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=30).read().decode()
+        report["drills"]["metrics"] = {
+            "resident_jobs_gauge": any(
+                line.startswith("tcr_serve_resident_jobs")
+                for line in metrics_txt.splitlines()),
+            "slice_busy_tenant_labels": sum(
+                1 for line in metrics_txt.splitlines()
+                if line.startswith("tcr_mesh_slice_busy{") and
+                "tenant=" in line),
+        }
+        gate.set()
+        snaps = wait_terminal(jobs_url, list(ledger.accepted_ids),
+                              args.timeout_s)
+        pool = daemon.allocator.snapshot()
+        daemon.request_stop()
+        th.join(timeout=120.0)
+        if th.is_alive():
+            raise RuntimeError("packed daemon did not drain")
+        counts = _terminal_counts(list(snaps.values()))
+        report["drills"]["packed"] = {
+            "workers": args.workers,
+            "resident_high_water": high_water,
+            "disjoint_slices": disjoint_ok,
+            "overlap_observed": overlap_seen,
+            "quarantined": pool["quarantined"],
+            "exit_code": out["exit"],
+        }
+        report.update({
+            "submitted": ledger.submitted,
+            "accepted": ledger.accepted,
+            "rejected_by_reason": dict(sorted(
+                ledger.rejected_by_reason.items())),
+            "completed": counts["done"],
+            "failed": counts["failed"],
+            "poisoned": counts["poisoned"],
+            "journaled_remaining": 0,
+            "runner": "stub",
+        })
+        report.update(summarize_waits(list(snaps.values())))
+        if high_water < min(2, args.workers):
+            report["invariants"].append(
+                f"resident high-water {high_water} never reached "
+                f"{min(2, args.workers)} — the pool never packed")
+        if not disjoint_ok:
+            report["invariants"].append(
+                "concurrent leases shared a device — slice isolation "
+                "is broken")
+        if not report["drills"]["metrics"]["resident_jobs_gauge"]:
+            report["invariants"].append(
+                "/metrics has no tcr_serve_resident_jobs gauge")
+        if report["drills"]["metrics"]["slice_busy_tenant_labels"] < 2:
+            report["invariants"].append(
+                "/metrics showed fewer than 2 tenant-labelled "
+                "tcr_mesh_slice_busy slices while the pool was packed")
+        if args.ledger:
+            from ont_tcrconsensus_tpu.obs import history as obs_history
+            from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+
+            # stub runner: no reads/s — the entry still carries the
+            # packed-residency evidence and the wait SLOs, and the load
+            # gate accepts it (reads_per_sec simply isn't gated)
+            cfg = RunConfig.from_dict(dict(template))
+            entry = obs_history.build_entry(
+                "serve_load",
+                fingerprint=obs_history.config_fingerprint(cfg),
+                sha=obs_history.git_sha(),
+                backend=obs_history.detect_backend(),
+                extra={
+                    "scenario": "packed",
+                    "p50_wait_s": report["wait_s"]["p50"],
+                    "p99_wait_s": report["wait_s"]["p99"],
+                    "workers": args.workers,
+                    "resident_high_water": high_water,
+                    "submitted": ledger.submitted,
+                    "accepted": ledger.accepted,
+                    "completed": counts["done"],
+                    "poisoned": counts["poisoned"],
+                    "rejected_by_reason": dict(ledger.rejected_by_reason),
+                },
+            )
+            obs_history.append_entry(args.ledger, entry)
+            report["drills"]["ledger_entry"] = {
+                "path": args.ledger, "fingerprint": entry["fingerprint"]}
+    finally:
+        run_mod.run_with_config = real_run
+    return report
+
+
 # --- scenario: sustained ------------------------------------------------------
 
 
@@ -883,7 +1058,8 @@ def parse_args(argv=None):
                     "daemon; emits a machine-readable load_report.json "
                     "with an exact rejection ledger.")
     ap.add_argument("--scenario", default="smoke",
-                    choices=("smoke", "sustained", "drain", "crash"))
+                    choices=("smoke", "packed", "sustained", "drain",
+                             "crash"))
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--mix",
                     default="ok=5,over_budget=2,invalid_config=2,"
@@ -899,6 +1075,9 @@ def parse_args(argv=None):
                          "pipeline with a short sleep — control-plane "
                          "coverage in seconds")
     ap.add_argument("--stub-job-s", type=float, default=0.05)
+    ap.add_argument("--workers", type=int, default=2,
+                    help="packed scenario: runner-pool width (resident "
+                         "jobs packed onto disjoint device slices)")
     ap.add_argument("--tenants", type=int, default=3)
     ap.add_argument("--regions", type=int, default=3)
     ap.add_argument("--molecules", type=int, default=2,
@@ -920,9 +1099,16 @@ def parse_args(argv=None):
                          "pipeline (simulation environments)")
     args = ap.parse_args(argv)
     if args.runner is None:
-        args.runner = "stub" if args.scenario == "smoke" else "real"
-    if args.runner == "stub" and args.scenario != "smoke":
-        ap.error("--runner stub is only meaningful for --scenario smoke")
+        args.runner = ("stub" if args.scenario in ("smoke", "packed")
+                       else "real")
+    if args.runner == "stub" and args.scenario not in ("smoke", "packed"):
+        ap.error("--runner stub is only meaningful for --scenario "
+                 "smoke/packed")
+    if args.runner == "real" and args.scenario == "packed":
+        ap.error("--scenario packed is a control-plane drill; the real "
+                 "data plane is covered by the slow packed e2e tests")
+    if args.scenario == "packed" and args.workers < 2:
+        ap.error("--scenario packed needs --workers >= 2")
     return args
 
 
@@ -936,8 +1122,19 @@ def main(argv=None) -> int:
         args.workdir = tempfile.mkdtemp(prefix="serve_load_")
     os.makedirs(args.workdir, exist_ok=True)
 
+    if args.scenario == "packed" and "JAX_PLATFORMS" not in os.environ:
+        # the pool needs >= workers devices; default to forced CPU
+        # devices unless the caller picked a platform themselves
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    if args.scenario == "packed":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
     runner = {
         "smoke": scenario_smoke,
+        "packed": scenario_packed,
         "sustained": scenario_sustained,
         "drain": lambda a: _subprocess_disruption(a, "drain"),
         "crash": lambda a: _subprocess_disruption(a, "crash"),

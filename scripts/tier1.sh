@@ -38,11 +38,15 @@
 # (one warm daemon serves two HTTP-submitted jobs — the second with ZERO
 # steady-state compiles and outputs byte-identical to the one-shot CLI —
 # plus the slow-marked drain e2e: SIGTERM-equivalent stop mid-queue ->
-# journal -> restarted daemon resumes both jobs to correct counts), and a
-# serve-load smoke (scripts/serve_load.py seeded burst against an
-# in-process stub daemon: exact per-reason rejection accounting,
-# saturation 429s, a mid-drain 503, journal resume-to-completion, and a
-# schema-valid load_report.json).
+# journal -> restarted daemon resumes both jobs to correct counts, plus
+# the slice-pack arm: two stub tenants resident at once on disjoint
+# slices with a device-lost isolation drill and a both-tenants drain
+# journal), a serve-load smoke (scripts/serve_load.py seeded burst
+# against an in-process stub daemon: exact per-reason rejection
+# accounting, saturation 429s, a mid-drain 503, journal
+# resume-to-completion, and a schema-valid load_report.json), and a
+# packed serve-load smoke (--scenario packed: resident high-water >= 2
+# on pairwise-disjoint slices under the same exact ledger).
 
 set -o pipefail
 cd "$(dirname "$0")/.."
@@ -256,11 +260,16 @@ if [ "$src" -ne 0 ]; then
 fi
 echo "--- warm-serving daemon smoke (warm daemon: job 2 dispatches with 0"
 echo "    XLA compiles + byte-identical artifacts; drain journals the queue"
-echo "    and a restarted daemon resumes it) ---"
+echo "    and a restarted daemon resumes it; slice-pack arm: two stub"
+echo "    tenants resident AT ONCE on disjoint slices, device_lost on A's"
+echo "    slice quarantines it and never perturbs B, drain journals every"
+echo "    resident) ---"
 # -m 'slow or not slow' overrides the default '-m not slow' addopts so the
-# slow-marked drain/restart e2e runs here by name
-timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q \
-    -k "serve_e2e or drain_journals" -m 'slow or not slow' \
+# slow-marked drain/restart e2e runs here by name; the heavy packed e2es
+# (test_packed_e2e_*) are slow-marked and deliberately NOT matched by -k
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_serve.py tests/test_serve_slices.py -q \
+    -k "serve_e2e or drain_journals or slice_pack" -m 'slow or not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly
 drc=$?
 if [ "$drc" -ne 0 ]; then
@@ -304,5 +313,43 @@ rm -rf "$load_tmp"
 if [ "$lvrc" -ne 0 ]; then
     echo "serve load report verification FAILED (rc=$lvrc)" >&2
     exit "$lvrc"
+fi
+
+echo "--- packed serve load smoke (scripts/serve_load.py --scenario packed:"
+echo "    a 2-wide runner pool packs stub tenants onto disjoint device"
+echo "    slices; the report proves resident high-water >= 2, pairwise-"
+echo "    disjoint leases, live tenant labels on /metrics, and the same"
+echo "    exact submitted == accepted + rejected accounting) ---"
+pack_tmp=$(mktemp -d)
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/serve_load.py \
+    --scenario packed --workers 2 --seed 11 --mix "ok=3,over_budget=1" \
+    --period-s 0.2 --stub-job-s 0.02 --queue-max 4 \
+    --workdir "$pack_tmp/state" --out "$pack_tmp/load_report.json"
+psrc=$?
+if [ "$psrc" -ne 0 ]; then
+    echo "packed serve load smoke FAILED (rc=$psrc)" >&2
+    rm -rf "$pack_tmp"
+    exit "$psrc"
+fi
+python - "$pack_tmp/load_report.json" <<'EOF'
+import json, sys
+sys.path.insert(0, "scripts")
+import serve_load
+report = json.load(open(sys.argv[1]))
+assert serve_load.validate_report(report) == [], "packed report schema"
+assert report["invariants"] == [], report["invariants"]
+packed = report["drills"]["packed"]
+assert packed["resident_high_water"] >= 2, packed
+assert packed["disjoint_slices"] is True, packed
+rej = sum(report["rejected_by_reason"].values())
+assert report["submitted"] == report["accepted"] + rej, report
+assert report["drills"]["metrics"]["slice_busy_tenant_labels"] >= 2, \
+    report["drills"]["metrics"]
+EOF
+pvrc=$?
+rm -rf "$pack_tmp"
+if [ "$pvrc" -ne 0 ]; then
+    echo "packed serve load verification FAILED (rc=$pvrc)" >&2
+    exit "$pvrc"
 fi
 echo "tier-1 OK"

@@ -323,6 +323,11 @@ def test_daemon_queue_max_from_template_and_override(tmp_path):
 # ---------------------------------------------------------------------------
 # e2e: one warm daemon, two tenants, zero steady-state compiles,
 # byte-identity vs the one-shot CLI path
+#
+# slow-marked: the warm_daemon_runs fixture costs ~45s (a full one-shot
+# baseline run plus a two-job daemon serve), so these run in tier1.sh's
+# dedicated daemon smoke arm (-k "serve_e2e or ..." -m 'slow or not slow')
+# rather than in the generic non-slow sweep.
 
 
 @pytest.fixture(scope="module")
@@ -427,6 +432,7 @@ def warm_daemon_runs(serve_library, tmp_path_factory):
     return lib, res_one, nano_one, w1, w2, snaps, listing, daemon, ledger
 
 
+@pytest.mark.slow
 def test_serve_e2e_jobs_complete_with_latency_tap(warm_daemon_runs):
     _, _, _, _, _, snaps, listing, daemon, _ = warm_daemon_runs
     for snap in snaps:
@@ -440,6 +446,7 @@ def test_serve_e2e_jobs_complete_with_latency_tap(warm_daemon_runs):
     assert daemon.warmup_s is not None and daemon.warmup_s > 0.0
 
 
+@pytest.mark.slow
 def test_serve_e2e_zero_steady_state_compiles(warm_daemon_runs):
     """The tentpole contract: the SECOND job through the warm daemon
     dispatches with zero XLA backend compiles — proven by its own
@@ -455,6 +462,7 @@ def test_serve_e2e_zero_steady_state_compiles(warm_daemon_runs):
     assert cache["armed"] is True and cache["dir"] == _TEST_CACHE
 
 
+@pytest.mark.slow
 def test_serve_e2e_outputs_byte_identical_to_oneshot(warm_daemon_runs):
     lib, res_one, nano_one, w1, w2, snaps, _, _, _ = warm_daemon_runs
     assert res_one == {"barcode01": lib.true_counts}
@@ -472,6 +480,7 @@ def test_serve_e2e_outputs_byte_identical_to_oneshot(warm_daemon_runs):
                 f"daemon path must not change {'/'.join(rel)}"
 
 
+@pytest.mark.slow
 def test_serve_e2e_prewarm_compiled_declared_buckets(warm_daemon_runs):
     _, _, _, _, _, _, _, daemon, _ = warm_daemon_runs
     report = daemon.prewarm_report
@@ -484,6 +493,7 @@ def test_serve_e2e_prewarm_compiled_declared_buckets(warm_daemon_runs):
     assert pol and not pol[0]["ok"]
 
 
+@pytest.mark.slow
 def test_serve_e2e_ledger_records_warm_steady_split(warm_daemon_runs):
     _, _, _, _, _, _, _, daemon, ledger = warm_daemon_runs
     entries, problems = obs_history.read_entries(ledger)
@@ -502,6 +512,7 @@ def test_serve_e2e_ledger_records_warm_steady_split(warm_daemon_runs):
         assert e["wait_s"] >= 0.0
 
 
+@pytest.mark.slow
 def test_serve_e2e_plane_disarmed_after_daemon(warm_daemon_runs):
     assert obs_live.server() is None
     assert obs_live._JOBS is None and obs_live._NODE_START_HOOK is None

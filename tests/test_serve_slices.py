@@ -1,0 +1,555 @@
+"""Slice-packed multi-tenant serving (serve/slices.py + the daemon
+runner pool): allocator units (buddy alignment, fragmentation, sizing,
+quarantine), packed-daemon contracts with a stubbed runner (two tenants
+resident concurrently on disjoint slices, device-lost isolating one
+tenant, drain journaling every resident), and the slow real-pipeline
+packed e2es (byte identity vs the serial daemon; tenant A degraded by a
+mesh device loss while tenant B's outputs stay byte-identical).
+
+The stubbed tests are the tier-1 slice-pack smoke (scripts/tier1.sh
+selects them by the ``slice_pack`` substring); the real-pipeline e2es
+are slow-marked.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+
+import pytest
+
+from ont_tcrconsensus_tpu.obs import live as obs_live
+from ont_tcrconsensus_tpu.obs import metrics as obs_metrics
+from ont_tcrconsensus_tpu.parallel.budget import BudgetModel
+from ont_tcrconsensus_tpu.pipeline.config import RunConfig
+from ont_tcrconsensus_tpu.robustness import faults, shutdown
+from ont_tcrconsensus_tpu.serve import queue as serve_queue
+from ont_tcrconsensus_tpu.serve import slices as serve_slices
+from ont_tcrconsensus_tpu.serve.daemon import Daemon
+
+_BASE = {"reference_file": "r.fa", "fastq_pass_dir": "fq"}
+
+
+def _mini_cfg(**over) -> RunConfig:
+    return RunConfig.from_dict({**_BASE, **over})
+
+
+class _Dev:
+    """Stand-in device: anything with .platform/.id labels like jax's."""
+
+    def __init__(self, i: int):
+        self.platform = "fake"
+        self.id = i
+
+    def __repr__(self):
+        return f"fake:{self.id}"
+
+
+def _alloc(n: int, hbm_gb: float = 12.0) -> serve_slices.SliceAllocator:
+    return serve_slices.SliceAllocator(
+        [_Dev(i) for i in range(n)], BudgetModel(hbm_gb))
+
+
+# ---------------------------------------------------------------------------
+# allocator units
+
+
+def test_config_serve_workers_validation():
+    assert _mini_cfg().serve_workers == 1
+    assert _mini_cfg(serve_workers=4).serve_workers == 4
+    with pytest.raises(ValueError, match="serve_workers"):
+        _mini_cfg(serve_workers=0)
+    with pytest.raises(ValueError, match="serve_workers"):
+        _mini_cfg(serve_workers=True)
+
+
+def test_allocator_allowance_is_degraded_budget_arithmetic():
+    alloc = _alloc(8, hbm_gb=16.0)
+    assert alloc.max_size == 8
+    # a slice of n of N devices gets exactly the degraded-mesh fraction
+    assert alloc.allowance(8).hbm_gb == pytest.approx(16.0)
+    assert alloc.allowance(2).hbm_gb == pytest.approx(4.0)
+    assert alloc.allowance(1).hbm_gb == pytest.approx(2.0)
+
+
+def test_allocator_size_for_smallest_fit_and_mesh_pin():
+    alloc = _alloc(8)
+    # a small job fits the smallest slice
+    size, detail = alloc.size_for(_mini_cfg(read_batch_size=96))
+    assert size == 1, detail
+    # an explicit mesh_shape pins the pow2 ceiling of its axis product
+    size, detail = alloc.size_for(
+        _mini_cfg(read_batch_size=96, mesh_shape={"data": 2}))
+    assert size == 2, detail
+    size, detail = alloc.size_for(_mini_cfg(mesh_shape={"data": 3}))
+    assert size == 4, detail
+    # a shape wider than the pool is a loud (None, why), not a wait
+    size, detail = alloc.size_for(_mini_cfg(mesh_shape={"data": 16}))
+    assert size is None and "largest grantable" in detail
+
+
+def test_allocator_alignment_makes_fragmentation_real():
+    alloc = _alloc(4)
+    for j in ("a", "b", "c", "d"):
+        assert alloc.try_assign(j, 1) is not None
+    assert alloc.try_assign("e", 1) is None  # full residency
+    # free the MIDDLE run 1..2: two free devices, but neither aligned
+    # pair (0..1, 2..3) is fully free — a 2-slice must wait, not carve
+    # a misaligned run
+    alloc.release("b")
+    alloc.release("c")
+    assert alloc.try_assign("e", 2) is None
+    assert alloc.can_ever_fit(2)  # ...but waiting is not hopeless
+    alloc.release("d")
+    lease = alloc.try_assign("e", 2)
+    assert lease is not None and (lease.start, lease.size) == (2, 2)
+
+
+def test_allocator_quarantine_survives_release_and_shrinks_admission():
+    alloc = _alloc(8)
+    obs_metrics.arm()
+    try:
+        a = alloc.try_assign("tenant-a", 4)
+        b = alloc.try_assign("tenant-b", 2)
+        assert (a.start, a.size) == (0, 4)
+        assert (b.start, b.size) == (4, 2)
+        labels = alloc.quarantine("tenant-a")
+        assert labels == [f"fake:{i}" for i in range(4)]
+        # the loss outlives the job: release returns nothing to the pool
+        alloc.release("tenant-a")
+        snap = alloc.snapshot()
+        assert snap["quarantined"] == 4
+        assert all(snap["devices"][f"fake:{i}"] == "quarantined"
+                   for i in range(4))
+        # B's disjoint lease never noticed
+        assert snap["leases"] == {
+            "tenant-b": {"slice": "4+2", "devices": ["fake:4", "fake:5"]}}
+        # the whole mesh is gone for good, but the aligned 4..7 run
+        # survives (busy counts: B frees later) — admission shrinks to
+        # the largest grantable slice (4 of 8)
+        assert not alloc.can_ever_fit(8)
+        assert alloc.can_ever_fit(4)
+        assert alloc.admission_budget().hbm_gb == pytest.approx(12.0 / 2)
+        # metered: quarantine counter up, busy gauge down, tenant cleared
+        reg = obs_metrics.registry()
+        assert reg.slice_quarantined == {f"fake:{i}": 1.0 for i in range(4)}
+        text = "\n".join(reg.prometheus_lines())
+        assert 'tcr_slice_quarantined_total{slice="fake:0"} 1' in text
+        assert 'tcr_mesh_slice_busy{slice="fake:4",tenant="tenant-b"} 1' \
+            in text
+    finally:
+        obs_metrics.disarm()
+
+
+def test_allocator_assign_chaos_fires_before_pool_mutation():
+    alloc = _alloc(2)
+    faults.arm([{"site": "serve.slice_assign", "kind": "error"}], seed=0)
+    try:
+        with pytest.raises(RuntimeError, match="serve.slice_assign"):
+            alloc.try_assign("a", 1)
+    finally:
+        faults.disarm()
+    # nothing leaked: the fault fired before the carve
+    assert alloc.snapshot()["leases"] == {}
+    assert alloc.try_assign("a", 1) is not None
+
+
+def test_allocator_pack_chaos_fires_after_pool_consistent():
+    alloc = _alloc(2)
+    assert alloc.try_assign("a", 2) is not None
+    faults.arm([{"site": "serve.pack", "kind": "error"}], seed=0)
+    try:
+        with pytest.raises(RuntimeError, match="serve.pack"):
+            alloc.release("a")
+    finally:
+        faults.disarm()
+    # the fault hit AFTER the devices went back: pool fully consistent
+    snap = alloc.snapshot()
+    assert snap["leases"] == {} and snap["quarantined"] == 0
+    assert alloc.try_assign("b", 2) is not None
+
+
+# ---------------------------------------------------------------------------
+# packed daemon with a stubbed runner (the tier-1 slice-pack smoke)
+
+
+class _StubRunner:
+    """Replaces run_with_config: records the slice it ran on, optionally
+    raises per-tenant, then parks on a gate polling the shutdown
+    checkpoint (so a daemon drain preempts it like a real run)."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.lock = threading.Lock()
+        self.calls: list[tuple[str, tuple]] = []  # (tag, devices)
+        self.raises: dict[str, list[BaseException]] = {}
+
+    def tag_calls(self, tag: str) -> list[tuple]:
+        with self.lock:
+            return [d for t, d in self.calls if t == tag]
+
+    def __call__(self, cfg):
+        from ont_tcrconsensus_tpu.parallel import mesh as mesh_mod
+
+        tag = os.path.basename(cfg.fastq_pass_dir)
+        with self.lock:
+            self.calls.append((tag, tuple(mesh_mod.slice_devices() or ())))
+            planned = self.raises.get(tag)
+            exc = planned.pop(0) if planned else None
+        if exc is not None:
+            raise exc
+        while not self.gate.wait(0.02):
+            shutdown.checkpoint("stub.run")
+        return {"barcode01": {"r1": 1}}
+
+
+@pytest.fixture
+def packed(tmp_path, monkeypatch):
+    """A 2-worker packed daemon over the suite's 8 CPU devices, its
+    runner stubbed; yields (daemon, runner, submit, exit_codes)."""
+    from ont_tcrconsensus_tpu.pipeline import run as run_mod
+
+    runner = _StubRunner()
+    monkeypatch.setattr(run_mod, "run_with_config", runner)
+    template = {**_BASE, "compile_cache_dir": "off"}
+    daemon = Daemon(template, port=0, state_dir=str(tmp_path / "state"),
+                    do_prewarm=False, workers=2)
+    codes: list[int] = []
+    loop = threading.Thread(
+        target=lambda: codes.append(daemon.serve_forever()),
+        name="serve-packed", daemon=True)
+    loop.start()
+    deadline = time.monotonic() + 60.0
+    while obs_live.server() is None and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert obs_live.server() is not None, "daemon never armed"
+
+    def submit(tag: str, **over) -> str:
+        # absolute per-test dir: a completed job appends history under
+        # <fastq_pass_dir>/nano_tcr/, which must not land in the repo cwd
+        status, snap = daemon.submit(
+            {"fastq_pass_dir": str(tmp_path / tag), **over})
+        assert status == 202, snap
+        return snap["id"]
+
+    try:
+        yield daemon, runner, submit, codes
+    finally:
+        runner.gate.set()
+        daemon.request_stop()
+        loop.join(timeout=60.0)
+        assert not loop.is_alive(), "packed daemon did not stop"
+
+
+def _wait(predicate, timeout: float = 30.0, what: str = "condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_slice_pack_two_tenants_resident_on_disjoint_slices(packed):
+    daemon, runner, submit, _ = packed
+    a, b = submit("fqA"), submit("fqB")
+    _wait(lambda: daemon.allocator.resident() == 2, what="2 residents")
+    snap = daemon.jobs_snapshot()
+    assert snap["resident_jobs"] == 2
+    leases = snap["slices"]["leases"]
+    assert set(leases) == {a, b}
+    # disjoint: no device appears in both tenants' slices
+    devs_a = set(leases[a]["devices"])
+    devs_b = set(leases[b]["devices"])
+    assert devs_a and devs_b and not (devs_a & devs_b)
+    # each run's mesh really came up over ITS slice's devices
+    _wait(lambda: runner.tag_calls("fqA") and runner.tag_calls("fqB"),
+          what="both stubs started")
+    got_a = {f"{d.platform}:{d.id}" for d in runner.tag_calls("fqA")[0]}
+    got_b = {f"{d.platform}:{d.id}" for d in runner.tag_calls("fqB")[0]}
+    assert got_a == devs_a and got_b == devs_b
+    # daemon-plane metrics: residency gauge + per-slice tenant labels
+    reg = obs_metrics.registry()
+    text = "\n".join(reg.prometheus_lines())
+    assert "tcr_serve_resident_jobs 2" in text
+    for dev in devs_a:
+        assert f'tcr_mesh_slice_busy{{slice="{dev}",tenant="{a}"}} 1' in text
+    runner.gate.set()
+    _wait(lambda: daemon.jobs_snapshot()["jobs_done"] == 2,
+          what="both jobs done")
+    final = daemon.jobs_snapshot()
+    assert final["resident_jobs"] == 0
+    assert all(j["state"] == "done" for j in final["jobs"])
+    assert final["slices"]["leases"] == {}
+    text = "\n".join(obs_metrics.registry().prometheus_lines())
+    assert "tcr_serve_resident_jobs 0" in text
+
+
+def test_slice_pack_device_lost_isolates_one_tenant(packed):
+    daemon, runner, submit, _ = packed
+    # tenant A's first run dies with DEVICE_LOST ESCAPING the mesh (no
+    # in-slice survivor); tenant B just runs
+    runner.raises["fqA"] = [
+        faults.DeviceLostChaosError("DEVICE_LOST: slice drill")]
+    b = submit("fqB")
+    _wait(lambda: daemon.allocator.resident() >= 1, what="B resident")
+    a = submit("fqA")
+    # A's slice is quarantined, A requeues for a fresh slice and — with
+    # the gate open for its retry — completes; B never noticed
+    _wait(lambda: daemon.allocator.snapshot()["quarantined"] >= 1,
+          what="quarantine after A's device loss")
+    assert daemon.jobs_snapshot()["jobs"], "jobs listing went away"
+    _wait(lambda: len(runner.tag_calls("fqA")) >= 2,
+          what="A's retry on a fresh slice")
+    runner.gate.set()
+    _wait(lambda: daemon.jobs_snapshot()["jobs_done"] == 2,
+          what="both tenants done")
+    snap = daemon.jobs_snapshot()
+    states = {j["id"]: j for j in snap["jobs"]}
+    assert states[a]["state"] == "done" and states[b]["state"] == "done"
+    # the retry resumed (committed stages carry over) on DIFFERENT devices
+    job_a = daemon.queue.job(a)
+    assert job_a.raw["resume"] is True and job_a.attempts == 1
+    first, second = runner.tag_calls("fqA")[:2]
+    assert not (set(first) & set(second)), "retry landed on the dead slice"
+    # B ran exactly once, uninterrupted
+    assert len(runner.tag_calls("fqB")) == 1
+    # the dead capacity is out of circulation and admission shrank
+    pool = snap["slices"]
+    assert pool["quarantined"] == 1
+    assert daemon.queue.budget.hbm_gb < daemon.budget.hbm_gb
+    # the isolation event is on /metrics
+    text = "\n".join(obs_metrics.registry().prometheus_lines())
+    assert "tcr_slice_quarantined_total" in text
+
+
+def test_slice_pack_pinned_whole_mesh_job_queues_until_repack(packed):
+    daemon, runner, submit, _ = packed
+    small = submit("fqSmall")
+    _wait(lambda: daemon.allocator.resident() == 1, what="small resident")
+    # the whole-mesh job cannot co-reside: free slices exist, but no
+    # aligned 8-run is free — it must STAY QUEUED, not be rejected
+    big = submit("fqBig", mesh_shape={"data": 8})
+    time.sleep(0.6)
+    states = {j["id"]: j["state"] for j in daemon.jobs_snapshot()["jobs"]}
+    assert states[big] in ("queued", "requeued"), states
+    assert states[small] == "running"
+    runner.gate.set()
+    _wait(lambda: daemon.jobs_snapshot()["jobs_done"] == 2,
+          what="repack ran the big job")
+    states = {j["id"]: j["state"] for j in daemon.jobs_snapshot()["jobs"]}
+    assert states == {small: "done", big: "done"}
+    # the big job really got the whole mesh
+    assert len(runner.tag_calls("fqBig")[0]) == 8
+
+
+def test_slice_pack_drain_journals_every_resident(packed):
+    daemon, runner, submit, codes = packed
+    a, b = submit("fqA"), submit("fqB")
+    c = submit("fqQueued")  # third tenant: queued behind the pool
+    _wait(lambda: daemon.allocator.resident() == 2, what="2 residents")
+    _wait(lambda: len(runner.tag_calls("fqA")) == 1
+          and len(runner.tag_calls("fqB")) == 1, what="both runs started")
+    # SIGTERM-equivalent: the daemon coordinator preempts BOTH resident
+    # runs at their next checkpoint; all three jobs must journal
+    daemon._coord.request("drill")
+    _wait(lambda: bool(codes), timeout=60.0, what="daemon drain")
+    assert codes == [143]
+    journal_file = serve_queue.journal_path(daemon.state_dir)
+    with open(journal_file) as fh:
+        journal = json.load(fh)
+    by_id = {j["id"]: j for j in journal["jobs"]}
+    assert set(by_id) == {a, b, c}
+    for jid in (a, b):
+        assert by_id[jid]["state"] == "requeued"
+        assert by_id[jid]["raw"]["resume"] is True
+    assert by_id[c]["state"] == "queued"
+
+
+# ---------------------------------------------------------------------------
+# slow: real-pipeline packed e2es (byte identity + tenant isolation)
+
+
+_TEST_CACHE = os.environ.get(
+    "JAX_TEST_CACHE_DIR",
+    os.path.join(os.path.dirname(__file__), ".jax_cache"),
+)
+
+
+@pytest.fixture(scope="module")
+def packed_library(tmp_path_factory):
+    from ont_tcrconsensus_tpu.io import fastx, simulator
+
+    tmp = tmp_path_factory.mktemp("packed_lib")
+    lib = simulator.simulate_library(
+        seed=31,
+        num_regions=2,
+        molecules_per_region=(2, 3),
+        reads_per_molecule=(5, 7),
+        sub_rate=0.006,
+        ins_rate=0.003,
+        del_rate=0.003,
+        region_len=(700, 850),
+    )
+    fastx.write_fasta(tmp / "reference.fa", lib.reference.items())
+    fq_dir = tmp / "fastq_pass" / "barcode01"
+    fq_dir.mkdir(parents=True)
+    fastx.write_fastq(fq_dir / "barcode01.fastq.gz", lib.reads)
+    return tmp, lib
+
+
+def _stage(src, root):
+    root.mkdir(parents=True, exist_ok=True)
+    shutil.copy(src / "reference.fa", root / "reference.fa")
+    shutil.copytree(src / "fastq_pass", root / "fastq_pass")
+    return root
+
+
+def _raw_cfg(root, **over) -> dict:
+    raw = {
+        "reference_file": str(root / "reference.fa"),
+        "fastq_pass_dir": str(root / "fastq_pass"),
+        "minimal_length": 600,
+        "min_reads_per_cluster": 4,
+        "read_batch_size": 96,
+        "polish_method": "poa",
+        "delete_tmp_files": False,
+        "compile_cache_dir": _TEST_CACHE,
+    }
+    raw.update(over)
+    return raw
+
+
+_ARTIFACTS = (
+    ("barcode01", "counts", "umi_consensus_counts.csv"),
+    ("barcode01", "fasta", "merged_consensus.fasta"),
+)
+
+
+def _run_packed(daemon, raws, resident_probe=None, timeout=900.0):
+    """Drive a packed daemon through ``raws``; returns the final jobs
+    listing. ``resident_probe`` is polled while waiting (concurrency
+    high-water tracking)."""
+    codes: list[int] = []
+    loop = threading.Thread(
+        target=lambda: codes.append(daemon.serve_forever()),
+        name="serve-packed-e2e", daemon=True)
+    loop.start()
+    try:
+        _wait(lambda: obs_live.server() is not None, timeout=120.0,
+              what="live plane")
+        ids = []
+        for raw in raws:
+            status, snap = daemon.submit(raw)
+            assert status == 202, snap
+            ids.append(snap["id"])
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if resident_probe is not None:
+                resident_probe()
+            listing = daemon.jobs_snapshot()
+            if listing["jobs_done"] >= len(raws):
+                break
+            time.sleep(0.1)
+        listing = daemon.jobs_snapshot()
+        assert listing["jobs_done"] == len(raws), listing
+        metrics_text = "\n".join(obs_metrics.registry().prometheus_lines())
+        pool = daemon.allocator.snapshot()
+    finally:
+        daemon.request_stop()
+        loop.join(timeout=120.0)
+    assert not loop.is_alive(), "packed daemon did not stop"
+    assert codes == [0]
+    return ids, listing, metrics_text, pool
+
+
+@pytest.mark.slow
+def test_packed_e2e_two_tenants_byte_identical_to_serial(
+        packed_library, tmp_path_factory):
+    """Two tenant jobs resident at once on disjoint slices produce counts
+    CSV + consensus FASTA byte-identical to the one-shot serial run."""
+    from ont_tcrconsensus_tpu.pipeline.run import run_with_config
+
+    src, lib = packed_library
+    base = tmp_path_factory.mktemp("packed_e2e")
+    oneshot = _stage(src, base / "oneshot")
+    res_one = run_with_config(RunConfig.from_dict(_raw_cfg(oneshot)))
+    assert res_one == {"barcode01": lib.true_counts}
+    nano_one = oneshot / "fastq_pass" / "nano_tcr"
+
+    w1 = _stage(src, base / "w1")
+    w2 = _stage(src, base / "w2")
+    daemon = Daemon(_raw_cfg(w1), port=0, state_dir=str(base / "state"),
+                    do_prewarm=False, workers=2)
+    high_water = [0]
+
+    def probe():
+        high_water[0] = max(high_water[0], daemon.allocator.resident())
+
+    _, listing, metrics_text, _ = _run_packed(
+        daemon, [_raw_cfg(w) for w in (w1, w2)], resident_probe=probe)
+    assert all(j["state"] == "done" for j in listing["jobs"]), listing
+    # the point of packing: both tenants were resident AT ONCE
+    assert high_water[0] >= 2, "tenants never overlapped"
+    assert "tcr_serve_resident_jobs" in metrics_text
+    for rel in _ARTIFACTS:
+        want = nano_one.joinpath(*rel).read_bytes()
+        for w in (w1, w2):
+            got = (w / "fastq_pass" / "nano_tcr").joinpath(*rel).read_bytes()
+            assert got == want, \
+                f"packed serving must not change {'/'.join(rel)}"
+
+
+@pytest.mark.slow
+def test_packed_e2e_device_lost_on_tenant_a_never_perturbs_tenant_b(
+        packed_library, tmp_path_factory):
+    """The isolation acceptance drill: mesh.device_lost fires inside
+    tenant A's 2-device slice. A's run degrades WITHIN its slice (2 -> 1)
+    and completes; the dead device is quarantined out of the pool; B —
+    resident on a disjoint slice the whole time — finishes byte-identical
+    and uninterrupted (its robustness report records nothing)."""
+    src, lib = packed_library
+    base = tmp_path_factory.mktemp("packed_chaos")
+    oneshot = _stage(src, base / "oneshot")
+    from ont_tcrconsensus_tpu.pipeline.run import run_with_config
+
+    run_with_config(RunConfig.from_dict(_raw_cfg(oneshot)))
+    nano_one = oneshot / "fastq_pass" / "nano_tcr"
+
+    wa = _stage(src, base / "wa")
+    wb = _stage(src, base / "wb")
+    daemon = Daemon(_raw_cfg(wa), port=0, state_dir=str(base / "state"),
+                    do_prewarm=False, workers=2)
+    raws = [
+        _raw_cfg(wa, mesh_shape={"data": 2}, chaos=[
+            {"site": "mesh.device_lost", "kind": "device-lost"},
+        ]),
+        _raw_cfg(wb),
+    ]
+    ids, listing, metrics_text, pool = _run_packed(daemon, raws)
+    states = {j["id"]: j["state"] for j in listing["jobs"]}
+    assert states == {ids[0]: "done", ids[1]: "done"}, listing
+    # A survived by degrading; the lost device left the pool for good
+    assert pool["quarantined"] == 1, pool
+    assert "tcr_slice_quarantined_total" in metrics_text
+    report_a = json.loads(
+        (wa / "fastq_pass" / "nano_tcr" / "robustness_report.json")
+        .read_text())
+    ev = next(e for e in report_a["events"] if e["site"] == "mesh.degraded")
+    assert ev["classification"] == "device_lost"
+    assert ev["detail"]["data_from"] == 2 and ev["detail"]["data_to"] == 1
+    # B's own report shows an untouched run: no degradation, no retries
+    report_b = json.loads(
+        (wb / "fastq_pass" / "nano_tcr" / "robustness_report.json")
+        .read_text())
+    assert report_b["events"] == [], report_b["events"]
+    # both tenants' artifacts — including degraded A's — byte-identical
+    for rel in _ARTIFACTS:
+        want = nano_one.joinpath(*rel).read_bytes()
+        for w in (wa, wb):
+            got = (w / "fastq_pass" / "nano_tcr").joinpath(*rel).read_bytes()
+            assert got == want, \
+                f"isolation drill changed {'/'.join(rel)}"
